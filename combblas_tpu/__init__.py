@@ -1,0 +1,35 @@
+"""combblas_tpu — a TPU-native combinatorial (sparse, semiring) BLAS.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of CombBLAS
+(the Combinatorial BLAS, reference: /root/reference): distributed semiring
+sparse linear algebra — SpGEMM, SpMV/SpMSpV, elementwise ops, reductions,
+k-select, indexing/assignment — over a 2D (optionally 3D) device mesh,
+plus the graph applications built on those primitives (Graph500 BFS,
+connected components, betweenness centrality, Markov clustering,
+matchings, orderings).
+
+Design (TPU-first, not a port):
+  * Local storage is a static-shape, padded, (row, col)-sorted COO tile
+    (`ops.tile`) — the pluggable "DER" concept of the reference
+    (SpMat.h:55) re-thought for XLA's static-shape compilation model.
+  * Semirings are traceable (add-monoid, multiply) pairs (`ops.semiring`)
+    fused by XLA into the local kernels — the equivalent of the
+    reference's template semirings (Semirings.h:51-257).
+  * Distribution is a `jax.sharding.Mesh(("r", "c"))` 2D grid
+    (`parallel.grid`, ≅ CommGrid.h) with SUMMA SpGEMM and 4-phase SpMV
+    expressed as shard_map collectives (all_gather / psum-family /
+    ppermute / all_to_all) over ICI instead of MPI.
+  * Vectors are dense value arrays + validity masks in grid-aligned
+    blocks (`parallel.distvec`, ≅ FullyDist*Vec) so the SpMV hot path
+    needs only axis-local collectives and no dynamic shapes.
+"""
+
+from combblas_tpu.ops import semiring, tile, generate
+from combblas_tpu.ops.semiring import (
+    Monoid, Semiring,
+    PLUS_TIMES_F64, PLUS_TIMES_F32, PLUS_TIMES_I32, MIN_PLUS_F32,
+    MAX_TIMES_F32, SELECT2ND_MAX_I32, SELECT2ND_MIN_I32, BOOL_OR_AND,
+    MIN_SELECT2ND_I32, MAX_SELECT2ND_F32,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+"""Local (per-device) algebra and kernels: semirings, segment reductions,
+static-shape sparse tiles, and graph generation."""

@@ -1,0 +1,65 @@
+"""Graph generation: R-MAT / Kronecker (Graph500) edge lists, on device.
+
+Capability parity: DistEdgeList::GenGraph500Data (DistEdgeList.cpp:223)
+wrapping the Graph500 v2.1 generator (RefGen21.h:271, graph500-1.2/
+generator/*.c) plus `PermEdges`/`RenameVertices` (DistEdgeList.h:114-117).
+
+TPU-native re-design: instead of a C library producing edge tuples on
+each MPI rank, edges are generated as one vectorized JAX computation —
+per recursion level, a uniform draw picks the quadrant for *all* edges at
+once (VPU-wide), accumulating row/col bits. Vertex relabeling uses a
+random permutation (jax.random.permutation) exactly like RenameVertices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+@partial(jax.jit, static_argnames=("scale", "edgefactor", "permute"))
+def rmat_edges(key: Array, scale: int, edgefactor: int = 16,
+               a: float = 0.57, b: float = 0.19, c: float = 0.19,
+               permute: bool = True) -> tuple[Array, Array]:
+    """R-MAT edge list: 2^scale vertices, edgefactor*2^scale directed edges.
+
+    Defaults are the Graph500 parameters (a,b,c,d)=(.57,.19,.19,.05)
+    (RefGen21.h / graph500 spec). Returns (rows, cols) int32 arrays of
+    length m = edgefactor << scale. Self-loops and duplicates are kept
+    (as in the reference; apps remove loops / dedup on matrix build).
+    """
+    n = 1 << scale
+    m = edgefactor << scale
+    kperm, key = jax.random.split(key)
+
+    def level(i, carry):
+        rows, cols, key = carry
+        key, sub = jax.random.split(key)
+        u = jax.random.uniform(sub, (m,))
+        # quadrants: (0,0) w.p. a, (0,1) b, (1,0) c, (1,1) d
+        rbit = u >= (a + b)
+        cbit = ((u >= a) & (u < a + b)) | (u >= (a + b + c))
+        rows = rows | (rbit.astype(jnp.int32) << i)
+        cols = cols | (cbit.astype(jnp.int32) << i)
+        return rows, cols, key
+
+    rows = jnp.zeros((m,), jnp.int32)
+    cols = jnp.zeros((m,), jnp.int32)
+    rows, cols, key = lax.fori_loop(0, scale, level, (rows, cols, key))
+
+    if permute:
+        perm = jax.random.permutation(kperm, n).astype(jnp.int32)
+        rows = perm[rows]
+        cols = perm[cols]
+    return rows, cols
+
+
+def symmetrize(rows: Array, cols: Array) -> tuple[Array, Array]:
+    """A + A^T edge set (the Graph500 symmetricization step,
+    TopDownBFS.cpp: `Apply(..)` after generation)."""
+    return (jnp.concatenate([rows, cols]), jnp.concatenate([cols, rows]))

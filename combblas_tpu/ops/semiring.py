@@ -1,0 +1,279 @@
+"""Semirings and monoids as JAX-traceable operator bundles.
+
+Capability parity with the reference's algebra layer:
+  * semiring structs with add/multiply/identity — Semirings.h:51-257
+  * functor library mapped to reduction ops     — Operations.h:46-301
+  * functor -> MPI_Op mapping (MPIOp.h:68)      — here: monoid ->
+    per-mesh-axis collective (psum/pmax/pmin) + segment reduction.
+
+The TPU-native re-design: instead of C++ templates instantiated per
+semiring, a `Semiring` is a pytree-free dataclass of pure functions that
+JAX traces straight into the local kernels (tile.py) and into the
+shard_map collectives (parallel/*). A monoid carries three execution
+strategies, all semantically `fold(combine, identity, ...)`:
+
+  - ``combine(a, b)``         scalar/elementwise combine (traced)
+  - ``segment_reduce(...)``   within-tile reduction keyed by row/col id
+  - ``axis_reduce(x, axis_name)`` cross-device reduction along a mesh axis
+
+Known monoids (plus/min/max/or/and) dispatch to XLA's native
+segment/collective primitives; arbitrary user monoids fall back to a
+sorted-scan segment reduction and an all_gather+fold collective, so user
+extensibility (the reference's headline feature) is preserved without
+giving up fused fast paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+Array = jax.Array
+_NEG_INF = float("-inf")
+_POS_INF = float("inf")
+
+
+def _identity_array(value, dtype):
+    """Identity element as a scalar of the right dtype (inf -> dtype max)."""
+    dtype = jnp.dtype(dtype)
+    if value == _POS_INF:
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.array(jnp.inf, dtype)
+        return jnp.array(jnp.iinfo(dtype).max, dtype)
+    if value == _NEG_INF:
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.array(-jnp.inf, dtype)
+        return jnp.array(jnp.iinfo(dtype).min, dtype)
+    return jnp.array(value, dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Monoid:
+    """A commutative monoid (combine, identity) with fused fast paths.
+
+    ``kind`` selects XLA-native implementations for the five standard
+    monoids; kind=None means "user monoid": correct generic fallbacks.
+    """
+
+    name: str
+    combine: Callable[[Array, Array], Array]
+    identity_value: Any                    # python scalar (may be +-inf)
+    kind: Optional[str] = None             # "add"|"min"|"max"|"or"|"and"|None
+    # Optional semantic hint: combine is idempotent (a+a == a). True for
+    # min/max/or/and; lets some algorithms skip dedup passes.
+    idempotent: bool = False
+
+    # -- scalar/elementwise ------------------------------------------------
+    def identity(self, dtype) -> Array:
+        return _identity_array(self.identity_value, dtype)
+
+    def fill(self, shape, dtype) -> Array:
+        return jnp.full(shape, self.identity(dtype), dtype)
+
+    # -- within-tile: segment reduction ------------------------------------
+    def segment_reduce(self, data: Array, segment_ids: Array,
+                       num_segments: int, *, sorted_ids: bool = False) -> Array:
+        """fold(combine) of ``data`` grouped by ``segment_ids``.
+
+        Out-of-range ids (e.g. padding pointed at ``num_segments``) are
+        dropped. Segments with no contribution hold the identity.
+        """
+        if self.kind == "add":
+            # jax segment_sum fills empty segments with 0 == identity.
+            return jax.ops.segment_sum(
+                data, segment_ids, num_segments,
+                indices_are_sorted=sorted_ids)
+        if self.kind == "max":
+            out = jax.ops.segment_max(
+                data, segment_ids, num_segments,
+                indices_are_sorted=sorted_ids)
+            return out  # segment_max fills empties with dtype min == identity
+        if self.kind == "min":
+            return jax.ops.segment_min(
+                data, segment_ids, num_segments,
+                indices_are_sorted=sorted_ids)
+        if self.kind == "or":
+            # segment_max fills empty segments with int32 min; compare > 0
+            # (not astype) so empties land on the OR identity False.
+            out = jax.ops.segment_max(
+                data.astype(jnp.int32), segment_ids, num_segments,
+                indices_are_sorted=sorted_ids)
+            return (out > 0).astype(data.dtype)
+        if self.kind == "and":
+            # empty segments fill with int32 max -> True == AND identity
+            out = jax.ops.segment_min(
+                data.astype(jnp.int32), segment_ids, num_segments,
+                indices_are_sorted=sorted_ids)
+            return (out > 0).astype(data.dtype)
+        return self._segment_reduce_generic(data, segment_ids, num_segments,
+                                            sorted_ids=sorted_ids)
+
+    def _segment_reduce_generic(self, data, segment_ids, num_segments, *,
+                                sorted_ids):
+        """Sorted segmented scan for arbitrary user monoids."""
+        if not sorted_ids:
+            order = jnp.argsort(segment_ids)
+            segment_ids = segment_ids[order]
+            data = data[order]
+        n = data.shape[0]
+        starts = jnp.concatenate(
+            [jnp.ones((1,), bool), segment_ids[1:] != segment_ids[:-1]])
+
+        def scan_op(a, b):
+            a_start, a_val = a
+            b_start, b_val = b
+            val = jnp.where(b_start, b_val, self.combine(a_val, b_val))
+            return (a_start | b_start, val)
+
+        _, acc = lax.associative_scan(scan_op, (starts, data))
+        is_last = jnp.concatenate(
+            [segment_ids[:-1] != segment_ids[1:], jnp.ones((1,), bool)])
+        # scatter segment tails; drop out-of-range (padding) segments
+        tgt = jnp.where(is_last, segment_ids, num_segments)
+        out = self.fill((num_segments,), data.dtype)
+        return out.at[tgt].set(acc, mode="drop")
+
+    # -- whole-array reduction --------------------------------------------
+    def reduce(self, data: Array, axis=None) -> Array:
+        if self.kind == "add":
+            return jnp.sum(data, axis=axis)
+        if self.kind == "max":
+            return jnp.max(data, axis=axis)
+        if self.kind == "min":
+            return jnp.min(data, axis=axis)
+        if self.kind == "or":
+            return jnp.max(data, axis=axis)
+        if self.kind == "and":
+            return jnp.min(data, axis=axis)
+        flat = jnp.moveaxis(data, axis, -1) if axis is not None else data.ravel()
+        return lax.reduce(flat, self.identity(data.dtype),
+                          self.combine, (flat.ndim - 1,))
+
+    # -- cross-device: mesh-axis collective (the MPIOp analogue) -----------
+    def axis_reduce(self, x: Array, axis_name) -> Array:
+        if self.kind == "add":
+            return lax.psum(x, axis_name)
+        if self.kind in ("max", "or"):
+            return lax.pmax(x, axis_name)
+        if self.kind in ("min", "and"):
+            return lax.pmin(x, axis_name)
+        gathered = lax.all_gather(x, axis_name)  # (axis_size, ...)
+        return lax.reduce(gathered, self.identity(x.dtype),
+                          self.combine, (0,))
+
+
+# ---------------------------------------------------------------------------
+# Standard monoids (Operations.h functor library equivalents)
+# ---------------------------------------------------------------------------
+
+PLUS = Monoid("plus", lax.add, 0, kind="add")
+TIMES_MONOID = Monoid("times", lax.mul, 1)
+MIN = Monoid("min", lax.min, _POS_INF, kind="min", idempotent=True)
+MAX = Monoid("max", lax.max, _NEG_INF, kind="max", idempotent=True)
+LOR = Monoid("lor", jnp.logical_or, False, kind="or", idempotent=True)
+LAND = Monoid("land", jnp.logical_and, True, kind="and", idempotent=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class Semiring:
+    """(add-monoid, multiply) with identity annihilation.
+
+    Contract (≅ the reference's semiring concept, Semirings.h): ``add`` is
+    a commutative monoid; ``multiply(a, b)`` maps missing operands
+    (represented as ``add.identity``) to ``add.identity`` — i.e. the add
+    identity annihilates — so padded/masked entries vanish in reductions.
+    Kernels additionally mask padding explicitly, so user multiplies that
+    violate annihilation (e.g. select2nd) still work on tiles; the axiom
+    only matters for the dense-vector formulations.
+    """
+
+    name: str
+    add: Monoid
+    multiply: Callable[[Array, Array], Array]
+    # dtype the add identity/annihilator lives in, for convenience fills
+    dtype: Any = jnp.float32
+
+    def zero(self, dtype=None) -> Array:
+        return self.add.identity(dtype or self.dtype)
+
+    def fill_zero(self, shape, dtype=None) -> Array:
+        return self.add.fill(shape, dtype or self.dtype)
+
+
+def _sel2nd(a, b):
+    del a
+    return b
+
+
+def _sel1st(a, b):
+    del b
+    return a
+
+
+# -- stock semirings (Semirings.h:51-257 equivalents) ------------------------
+PLUS_TIMES_F64 = Semiring("plus_times_f64", PLUS, lax.mul, jnp.float64)
+PLUS_TIMES_F32 = Semiring("plus_times_f32", PLUS, lax.mul, jnp.float32)
+PLUS_TIMES_I32 = Semiring("plus_times_i32", PLUS, lax.mul, jnp.int32)
+#: tropical / shortest path (MinPlusSRing, Semirings.h:236)
+MIN_PLUS_F32 = Semiring("min_plus_f32", MIN, lax.add, jnp.float32)
+MAX_TIMES_F32 = Semiring("max_times_f32", MAX, lax.mul, jnp.float32)
+#: BFS parent propagation (SelectMaxSRing, Semirings.h:166)
+SELECT2ND_MAX_I32 = Semiring("select2nd_max_i32", MAX, _sel2nd, jnp.int32)
+SELECT2ND_MIN_I32 = Semiring("select2nd_min_i32", MIN, _sel2nd, jnp.int32)
+#: FastSV hooking (Select2ndMinSR, FastSV.h:25)
+MIN_SELECT2ND_I32 = SELECT2ND_MIN_I32
+MAX_SELECT2ND_F32 = Semiring("select2nd_max_f32", MAX, _sel2nd, jnp.float32)
+#: boolean reachability (BoolCopy*SRing / PTBOOL patterns)
+BOOL_OR_AND = Semiring("bool_or_and", LOR, jnp.logical_and, jnp.bool_)
+
+
+def dense_matmul(sr: Semiring, a: Array, b: Array, k_block: int = 128) -> Array:
+    """Dense semiring matmul c[i,j] = add_k mul(a[i,k], b[k,j]).
+
+    PlusTimes lowers to a plain MXU matmul; general semirings run a
+    blocked broadcast-reduce over k (the reference has no dense GEMM —
+    this is the golden-model kernel for tests and the dense fallback for
+    small tiles).
+    """
+    if sr.add.kind == "add" and sr.multiply in (lax.mul, jnp.multiply):
+        return jnp.matmul(a, b, precision=lax.Precision.HIGHEST)
+    m, k = a.shape
+    k2, n = b.shape
+    assert k == k2
+    nblk = -(-k // k_block)
+    kpad = nblk * k_block
+    ident = sr.add.identity(jnp.result_type(a.dtype, b.dtype))
+    a = jnp.pad(a, ((0, 0), (0, kpad - k)), constant_values=ident)
+    b = jnp.pad(b, ((0, kpad - k), (0, 0)), constant_values=ident)
+
+    def body(i, acc):
+        ablk = lax.dynamic_slice(a, (0, i * k_block), (m, k_block))
+        bblk = lax.dynamic_slice(b, (i * k_block, 0), (k_block, n))
+        prod = sr.multiply(ablk[:, :, None], bblk[None, :, :])
+        return sr.add.combine(acc, sr.add.reduce(prod, axis=1))
+
+    acc0 = jnp.full((m, n), ident)
+    return lax.fori_loop(0, nblk, body, acc0)
+
+
+def plus_times(dtype) -> Semiring:
+    return Semiring(f"plus_times_{jnp.dtype(dtype).name}", PLUS, lax.mul, dtype)
+
+
+def min_plus(dtype) -> Semiring:
+    return Semiring(f"min_plus_{jnp.dtype(dtype).name}", MIN, lax.add, dtype)
+
+
+def select2nd_max(dtype) -> Semiring:
+    return Semiring(f"select2nd_max_{jnp.dtype(dtype).name}", MAX, _sel2nd, dtype)
+
+
+def select2nd_min(dtype) -> Semiring:
+    return Semiring(f"select2nd_min_{jnp.dtype(dtype).name}", MIN, _sel2nd, dtype)

@@ -1,0 +1,34 @@
+"""Test environment: emulate an 8-device TPU mesh on CPU.
+
+The reference tests by launching real MPI ranks on one host
+(`mpirun -n 4|16`, ReleaseTests/CMakeLists.txt:38-49); the JAX analogue
+is XLA's host-platform device-count override, giving 8 real (CPU)
+devices over which every mesh/collective path executes for real.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,194 @@
+"""Local tile kernels vs dense golden models (the MultTest pattern:
+golden-file / cross-implementation comparison, ReleaseTests/MultTest.cpp)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import tile as T
+from combblas_tpu.ops import semiring as S
+
+
+def random_sparse(rng, m, n, density=0.2, dtype=np.float32):
+    dense = rng.random((m, n)).astype(dtype)
+    dense[rng.random((m, n)) > density] = 0.0
+    return dense
+
+
+def make_tile(dense, cap=None, zero=0.0):
+    m, n = dense.shape
+    cap = cap or m * n
+    return T.from_dense(jnp.asarray(dense), jnp.asarray(zero, dense.dtype), cap)
+
+
+class TestRoundTrip:
+    def test_from_to_dense(self, rng):
+        d = random_sparse(rng, 13, 17)
+        t = make_tile(d, cap=300)
+        np.testing.assert_array_equal(np.asarray(T.to_dense(t, 0.0)), d)
+        assert int(t.nnz) == np.count_nonzero(d)
+
+    def test_from_coo_dedup(self, rng):
+        rows = jnp.array([3, 1, 3, 0, 1], jnp.int32)
+        cols = jnp.array([2, 1, 2, 0, 1], jnp.int32)
+        vals = jnp.array([1.0, 2.0, 5.0, 3.0, 4.0], jnp.float32)
+        t = T.from_coo(S.PLUS, rows, cols, vals, nrows=4, ncols=3, cap=8)
+        assert int(t.nnz) == 3
+        d = np.asarray(T.to_dense(t, 0.0))
+        expect = np.zeros((4, 3), np.float32)
+        expect[3, 2] = 6.0
+        expect[1, 1] = 6.0
+        expect[0, 0] = 3.0
+        np.testing.assert_array_equal(d, expect)
+
+    def test_sorted_invariant(self, rng):
+        d = random_sparse(rng, 20, 20)
+        t = make_tile(d)
+        r, c, v = np.asarray(t.rows), np.asarray(t.cols), int(t.nnz)
+        keys = r[:v].astype(np.int64) * 21 + c[:v]
+        assert (np.diff(keys) > 0).all()
+
+    def test_overflow_truncates(self, rng):
+        rows = jnp.arange(10, dtype=jnp.int32)
+        cols = jnp.arange(10, dtype=jnp.int32)
+        vals = jnp.ones((10,), jnp.float32)
+        t = T.from_coo(S.PLUS, rows, cols, vals, nrows=10, ncols=10, cap=4)
+        assert int(t.nnz) == 4
+
+
+class TestStructural:
+    def test_transpose(self, rng):
+        d = random_sparse(rng, 9, 14)
+        t = T.transpose(make_tile(d))
+        np.testing.assert_array_equal(np.asarray(T.to_dense(t, 0.0)), d.T)
+
+    def test_concat_merge(self, rng):
+        d1 = random_sparse(rng, 8, 8)
+        d2 = random_sparse(rng, 8, 8)
+        t = T.concat_merge(S.PLUS, [make_tile(d1), make_tile(d2)], cap=128)
+        np.testing.assert_allclose(
+            np.asarray(T.to_dense(t, 0.0)), d1 + d2, rtol=1e-6)
+
+    def test_row_starts(self, rng):
+        d = random_sparse(rng, 11, 7)
+        t = make_tile(d)
+        ptr = np.asarray(T.row_starts(t))
+        per_row = (d != 0).sum(axis=1)
+        np.testing.assert_array_equal(np.diff(ptr), per_row)
+
+
+class TestSpMV:
+    def test_plus_times(self, rng):
+        d = random_sparse(rng, 15, 12)
+        x = rng.random(12).astype(np.float32)
+        y = T.spmv(S.PLUS_TIMES_F32, make_tile(d), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y), d @ x, rtol=1e-5)
+
+    def test_min_plus(self, rng):
+        d = np.full((6, 6), np.inf, np.float32)
+        mask = rng.random((6, 6)) < 0.5
+        d[mask] = rng.random(mask.sum()).astype(np.float32)
+        x = rng.random(6).astype(np.float32)
+        t = T.from_dense(jnp.asarray(d), jnp.asarray(np.inf, jnp.float32), 36)
+        y = np.asarray(T.spmv(S.MIN_PLUS_F32, t, jnp.asarray(x)))
+        expect = np.min(d + x[None, :], axis=1)
+        np.testing.assert_allclose(y, expect, rtol=1e-6)
+
+    def test_select2nd_max_fringe(self, rng):
+        # BFS step semantics: propagate max of active x along edges
+        d = (random_sparse(rng, 10, 10, density=0.4) != 0).astype(np.int32)
+        x = np.full(10, np.iinfo(np.int32).min, np.int32)
+        active = np.zeros(10, bool)
+        active[[2, 5]] = True
+        x[2], x[5] = 20, 50
+        t = T.from_dense(jnp.asarray(d), jnp.asarray(0, jnp.int32), 128)
+        y = np.asarray(T.spmv_masked(
+            S.SELECT2ND_MAX_I32, t, jnp.asarray(x), jnp.asarray(active)))
+        expect = np.full(10, np.iinfo(np.int32).min, np.int64)
+        for i in range(10):
+            vals = [x[j] for j in (2, 5) if d[i, j]]
+            if vals:
+                expect[i] = max(vals)
+        np.testing.assert_array_equal(y, expect)
+
+
+class TestSpGEMM:
+    @pytest.mark.parametrize("sr,zero", [
+        (S.PLUS_TIMES_F32, 0.0),
+        (S.MIN_PLUS_F32, np.inf),
+    ])
+    def test_vs_dense(self, rng, sr, zero):
+        m, k, n = 12, 10, 9
+        da = random_sparse(rng, m, k, 0.3)
+        db = random_sparse(rng, k, n, 0.3)
+        if np.isinf(zero):
+            da[da == 0] = np.inf
+            db[db == 0] = np.inf
+        ta = T.from_dense(jnp.asarray(da), jnp.asarray(zero, jnp.float32), 64)
+        tb = T.from_dense(jnp.asarray(db), jnp.asarray(zero, jnp.float32), 64)
+        flops = int(T.spgemm_flops(ta, tb))
+        tc = T.spgemm(sr, ta, tb, flops_cap=max(flops, 1), out_cap=m * n)
+        got = np.asarray(T.to_dense(tc, jnp.asarray(zero, jnp.float32)))
+        expect = np.asarray(S.dense_matmul(sr, jnp.asarray(da), jnp.asarray(db)))
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_flops_oracle(self, rng):
+        da = random_sparse(rng, 8, 8, 0.3)
+        db = random_sparse(rng, 8, 8, 0.3)
+        ta, tb = make_tile(da), make_tile(db)
+        expect = sum((da[i] != 0).astype(int) @ (db != 0).sum(1)
+                     for i in range(8))
+        assert int(T.spgemm_flops(ta, tb)) == int(expect)
+
+    def test_bool_reachability(self, rng):
+        d = (random_sparse(rng, 10, 10, 0.3) != 0)
+        t = T.from_dense(jnp.asarray(d), jnp.asarray(False), 128)
+        flops = int(T.spgemm_flops(t, t))
+        tc = T.spgemm(S.BOOL_OR_AND, t, t, flops_cap=max(flops, 1),
+                      out_cap=100)
+        got = np.asarray(T.to_dense(tc, jnp.asarray(False)))
+        np.testing.assert_array_equal(got, (d.astype(int) @ d.astype(int)) > 0)
+
+
+class TestRegressions:
+    def test_bool_or_empty_rows(self):
+        # empty segments must get the OR identity False, not int-min->True
+        d = np.zeros((3, 3), bool)
+        d[0, 1] = True
+        t = T.from_dense(jnp.asarray(d), jnp.asarray(False), 8)
+        x = jnp.asarray([False, True, False])
+        y = np.asarray(T.spmv(S.BOOL_OR_AND, t, x))
+        np.testing.assert_array_equal(y, [True, False, False])
+
+    def test_from_dense_honors_large_cap(self):
+        d = np.eye(4, dtype=np.float32)
+        t = T.from_dense(jnp.asarray(d), jnp.asarray(0.0, jnp.float32), 30)
+        assert t.cap == 30 and int(t.nnz) == 4
+        np.testing.assert_array_equal(np.asarray(T.to_dense(t, 0.0)), d)
+
+    def test_flops_host_int64(self, rng):
+        d = np.ones((40, 40), np.float32)
+        t = make_tile(d)
+        assert T.spgemm_flops(t, t) == 40 * 40 * 40
+        assert isinstance(T.spgemm_flops(t, t), int)
+
+
+class TestMonoids:
+    def test_generic_segment_reduce_matches_sum(self, rng):
+        import jax.numpy as jnp
+        from jax import lax
+        data = jnp.asarray(rng.random(50).astype(np.float32))
+        segs = jnp.asarray(rng.integers(0, 10, 50).astype(np.int32))
+        generic = S.Monoid("gadd", lax.add, 0)  # no kind -> generic path
+        got = np.asarray(generic.segment_reduce(data, segs, 10))
+        expect = np.asarray(S.PLUS.segment_reduce(data, segs, 10))
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_dense_matmul_generic_plus_times(self, rng):
+        a = jnp.asarray(rng.random((9, 7)).astype(np.float32))
+        b = jnp.asarray(rng.random((7, 5)).astype(np.float32))
+        from jax import lax
+        sr = S.Semiring("pt_generic", S.Monoid("gadd", lax.add, 0), lambda x, y: x * y)
+        np.testing.assert_allclose(
+            np.asarray(S.dense_matmul(sr, a, b, k_block=4)),
+            np.asarray(a) @ np.asarray(b), rtol=1e-4)
