@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Headline benchmark: Graph500 BFS TEPS on R-MAT (BASELINE.json metric).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N}
+
+vs_baseline is against the reference's strongest committed in-tree BFS
+log on comparable scale: 173.0 MTEPS median, Graph500 scale-22 ef16 on
+64 MPI ranks (BASELINE.md; CarverResults/scale22_p64_july11.run). This
+benchmark runs on however many TPU chips are visible (usually one).
+"""
+
+import argparse
+import json
+import sys
+
+BASELINE_GTEPS = 0.173
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=20)
+    ap.add_argument("--edgefactor", type=int, default=16)
+    ap.add_argument("--nroots", type=int, default=8)
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    grid = ProcGrid.make()
+    stats = B.graph500_run(grid, scale=args.scale,
+                           edgefactor=args.edgefactor,
+                           nroots=args.nroots, verbose=args.verbose)
+    s = stats.summary()
+    gteps = s["median_teps"] / 1e9
+    print(json.dumps({
+        "metric": f"graph500_bfs_scale{args.scale}_ef{args.edgefactor}_"
+                  f"{len(jax.devices())}chip_median",
+        "value": round(gteps, 4),
+        "unit": "GTEPS",
+        "vs_baseline": round(gteps / BASELINE_GTEPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+"""Graph applications built on the distributed primitives
+(≅ the reference's Applications/ tree)."""

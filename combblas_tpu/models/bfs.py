@@ -1,0 +1,205 @@
+"""Graph500 breadth-first search.
+
+Capability parity: Applications/TopDownBFS.cpp — generate→symmetricize→
+per-root loop of { setNumToInd; SpMV with SelectMax semiring;
+EWiseMult(fringe, parents, exclude); parents.Set } (:437-442), plus the
+tree validation and TEPS statistics (:452-524).
+
+TPU-native re-design: the whole per-root BFS is ONE jitted
+`lax.while_loop` with zero host round-trips (the BASELINE.json north
+star). The fringe is a masked dense vector (distvec design note), so
+`setNumToInd` is an iota, `EWiseMult(..., exclude)` is a mask-and, and
+`parents.Set` is a `where`. The SpMV fan-in/fan-out runs on mesh
+collectives via parallel.spmv.spmsv.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel import spmv as pspmv
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+# NB: python ints, NOT jnp scalars — a committed device array captured in
+# a jit closure forces a per-call constant re-upload on remote-TPU
+# backends (~400ms/call); see .claude/skills/verify/SKILL.md.
+NO_PARENT = -1
+_IDENT = jnp.iinfo(jnp.int32).min  # add-identity of the Max monoid
+
+
+@partial(jax.jit, static_argnames=())
+def bfs(a: dm.DistSpMat, root) -> dv.DistVec:
+    """Top-down BFS; returns the parents vector (r-aligned, int32).
+
+    ``a`` must hold the *incoming*-edge orientation (a[i, j] nonzero
+    means edge j→i reaches i) — symmetric Graph500 graphs satisfy this
+    trivially; otherwise pass `distmat.transpose(a)` (the reference's
+    OptimizeForGraph500 does the same transpose once, SpParMat.cpp:3285).
+    """
+    n = a.nrows
+    grid = a.grid
+    root = jnp.asarray(root, jnp.int32)
+
+    parents0 = jnp.full((grid.pr, a.tile_m), NO_PARENT, jnp.int32)
+    parents0 = parents0.at[root // a.tile_m, root % a.tile_m].set(root)
+    # fringe activity, column-aligned
+    act0 = jnp.zeros((grid.pc, a.tile_n), bool)
+    act0 = act0.at[root // a.tile_n, root % a.tile_n].set(True)
+
+    # x values = own global vertex id (≅ fringe.setNumToInd());
+    # computed inline (trace-time), never closed-over device data
+    xval = (jnp.arange(grid.pc, dtype=jnp.int32)[:, None] * a.tile_n
+            + jnp.arange(a.tile_n, dtype=jnp.int32)[None, :])
+
+    def cond(carry):
+        _, _, cont = carry
+        return cont
+
+    def body(carry):
+        parents, act_c, _ = carry
+        fringe = dv.DistSpVec(xval, act_c, grid, COL_AXIS, n)
+        y = pspmv.spmsv(S.SELECT2ND_MAX_I32, a, fringe)
+        fresh = y.active & (parents == NO_PARENT)
+        parents = jnp.where(fresh, y.data, parents)
+        new_r = dv.DistVec(fresh, grid, ROW_AXIS, n)
+        act_c = dv.realign(new_r, COL_AXIS, block=a.tile_n,
+                           fill=False).data
+        return parents, act_c, jnp.any(fresh)
+
+    parents, _, _ = lax.while_loop(cond, body, (parents0, act0, jnp.bool_(True)))
+    return dv.DistVec(parents, grid, ROW_AXIS, n)
+
+
+# ---------------------------------------------------------------------------
+# Validation + statistics (≅ TopDownBFS.cpp:452-524)
+# ---------------------------------------------------------------------------
+
+def validate_bfs(edges_r: np.ndarray, edges_c: np.ndarray, n: int,
+                 root: int, parents: np.ndarray) -> dict:
+    """Graph500-style host-side spec check of a parents array:
+    (1) parents[root] == root; (2) every tree edge (parent[v], v) is a
+    graph edge; (3) tree levels differ by exactly 1 along tree edges;
+    (4) exactly the root's connected component is visited."""
+    assert parents[root] == root, "root not its own parent"
+    visited = parents >= 0
+    # component via union-find on host
+    import scipy.sparse as sp
+    import scipy.sparse.csgraph as csg
+    g = sp.coo_matrix((np.ones(len(edges_r)), (edges_r, edges_c)),
+                      shape=(n, n)).tocsr()
+    ncomp, labels = csg.connected_components(g, directed=False)
+    comp_mask = labels == labels[root]
+    assert (visited == comp_mask).all(), "visited set != root's component"
+    # levels by parent-chasing
+    level = np.full(n, -1, np.int64)
+    level[root] = 0
+    frontier = [root]
+    children = {}
+    for v in np.nonzero(visited)[0]:
+        if v != root:
+            children.setdefault(parents[v], []).append(v)
+    depth = 0
+    while frontier:
+        depth += 1
+        nxt = []
+        for u in frontier:
+            for v in children.get(u, ()):  # tree edges
+                level[v] = level[u] + 1
+                nxt.append(v)
+        frontier = nxt
+    assert (level[visited] >= 0).all(), "parent pointers contain a cycle"
+    # every tree edge must exist in the graph
+    tv = np.nonzero(visited & (np.arange(n) != root))[0]
+    tp = parents[tv]
+    has_edge = np.asarray(g[tp, tv]).ravel() != 0
+    has_edge |= np.asarray(g[tv, tp]).ravel() != 0
+    assert has_edge.all(), "tree edge not in graph"
+    nedges = int(comp_mask[edges_r].sum() // 2)  # sym edge list counted once
+    return {"visited": int(visited.sum()), "depth": int(level.max()),
+            "nedges": nedges}
+
+
+@dataclasses.dataclass
+class BfsRunStats:
+    teps: list
+    times: list
+    visited: list
+
+    def summary(self) -> dict:
+        teps = np.asarray(self.teps)
+        return {
+            "min_teps": float(teps.min()),
+            "median_teps": float(np.median(teps)),
+            "max_teps": float(teps.max()),
+            "harmonic_mean_teps": float(1.0 / np.mean(1.0 / teps)),
+            "mean_time": float(np.mean(self.times)),
+        }
+
+
+def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
+                 nroots: int = 16, seed: int = 1, cap_slack: float = 1.15,
+                 validate: bool = False, verbose: bool = False) -> BfsRunStats:
+    """End-to-end Graph500 kernel-2 harness: generate R-MAT, build the
+    symmetric adjacency matrix, run BFS from random roots, report TEPS
+    (edges in the traversed component / time, per the reference's
+    counting recipe — BASELINE.md notes)."""
+    import time
+
+    key = jax.random.key(seed)
+    kgen, kroots = jax.random.split(key)
+    n = 1 << scale
+    r, c = generate.rmat_edges(kgen, scale, edgefactor)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n,
+                           cap=int(cap_slack * (r.shape[0] //
+                                                (grid.pr * grid.pc))))
+    jax.block_until_ready(a.rows)
+    if verbose:
+        a.print_info("A")
+
+    # degrees for root selection (roots must have degree > 0)
+    deg = np.zeros(n, np.int64)
+    np.add.at(deg, np.asarray(r), 1)
+    candidates = np.nonzero(deg > 0)[0]
+    roots = np.asarray(jax.random.choice(
+        kroots, jnp.asarray(candidates), (nroots,), replace=False))
+
+    er = ec = None
+    if validate:
+        er, ec = np.asarray(r), np.asarray(c)
+
+    stats = BfsRunStats([], [], [])
+    # warm-up compile (not timed, like the reference's untimed iteration 0)
+    bfs(a, jnp.int32(roots[0])).data.block_until_ready()
+    for root in roots:
+        t0 = time.perf_counter()
+        parents = bfs(a, jnp.int32(root))
+        parents.data.block_until_ready()
+        dt = time.perf_counter() - t0
+        pg = parents.to_global()
+        visited = int((pg >= 0).sum())
+        if validate:
+            info = validate_bfs(er, ec, n, int(root), pg)
+            nedges = info["nedges"]
+        else:
+            nedges = int(deg[pg >= 0].sum() // 2)
+        stats.teps.append(nedges / dt)
+        stats.times.append(dt)
+        stats.visited.append(visited)
+        if verbose:
+            print(f"root {int(root)}: {visited} visited, "
+                  f"{nedges} edges, {dt*1e3:.1f} ms, "
+                  f"{nedges/dt/1e6:.1f} MTEPS")
+    return stats

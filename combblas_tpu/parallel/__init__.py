@@ -1,0 +1,6 @@
+"""Distributed layer: process-grid mesh, distributed sparse matrices and
+vectors, and the collective algorithms (SpMV, SUMMA SpGEMM) over them."""
+
+from combblas_tpu.parallel.grid import ProcGrid
+from combblas_tpu.parallel.distmat import DistSpMat
+from combblas_tpu.parallel.distvec import DistVec, DistSpVec

@@ -1,0 +1,177 @@
+"""Distributed vectors, dense and sparse, grid-aligned.
+
+Capability parity: `FullyDist` / `FullyDistVec` / `FullyDistSpVec`
+(FullyDist.h:63-77, FullyDistVec.h, FullyDistSpVec.h) — vectors
+distributed so matrix-vector alignment needs no global reshuffle.
+
+TPU-native re-design: a vector is a dense (nblocks, block) array plus
+an ``axis`` tag saying which mesh axis the blocks are sharded over
+("r": block i on the devices of grid row i, replicated across the
+row; "c": likewise for columns). SpMV consumes a "c"-aligned x and
+produces an "r"-aligned y. On a square grid with equal tile sizes the
+r↔c realignment is a pure resharding (the data layout is identical),
+which XLA lowers to the transpose-pair exchange the reference
+implements by hand (TransposeVector, ParFriends.h:1388).
+
+A *sparse* vector (FullyDistSpVec) is the same dense value array plus
+a boolean activity mask — static shapes, no index lists. This is the
+design decision that makes SpMSpV jittable: frontier sparsity becomes
+masking, and "nnz" is a reduction, not a shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from combblas_tpu.ops.semiring import Monoid, Semiring
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+Array = jax.Array
+
+
+def _ceil_div(a, b):
+    return -(-a // b)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DistVec:
+    """Dense distributed vector (≅ FullyDistVec)."""
+
+    data: Array                     # (nblocks, block)
+    grid: ProcGrid = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))  # "r"|"c"
+    glen: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def nblocks(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def block(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def spec(self) -> P:
+        return P(self.axis, None)
+
+    def valid_mask(self) -> Array:
+        """(nblocks, block) mask of positions < glen (pad exclusion)."""
+        pos = (jnp.arange(self.nblocks, dtype=jnp.int32)[:, None] * self.block
+               + jnp.arange(self.block, dtype=jnp.int32)[None, :])
+        return pos < self.glen
+
+    def global_index(self) -> Array:
+        """(nblocks, block) global position ids (≅ iota / setNumToInd)."""
+        return (jnp.arange(self.nblocks, dtype=jnp.int32)[:, None] * self.block
+                + jnp.arange(self.block, dtype=jnp.int32)[None, :])
+
+    def to_global(self) -> np.ndarray:
+        return np.asarray(self.data).reshape(-1)[:self.glen]
+
+    def map(self, fn) -> "DistVec":
+        """Elementwise Apply (≅ FullyDistVec::Apply)."""
+        return dataclasses.replace(self, data=fn(self.data))
+
+    def reduce(self, monoid: Monoid, fill=None):
+        """Global reduction over live positions (≅ Reduce)."""
+        fill = monoid.identity(self.dtype) if fill is None else fill
+        masked = jnp.where(self.valid_mask(), self.data, fill)
+        return monoid.reduce(masked)
+
+
+def constant(grid: ProcGrid, axis: str, glen: int, value, dtype,
+             block: Optional[int] = None) -> DistVec:
+    nb = grid.pr if axis == ROW_AXIS else grid.pc
+    block = block or _ceil_div(glen, nb)
+    data = jnp.full((nb, block), value, dtype)
+    data = jax.device_put(data, grid.sharding(axis, None))
+    return DistVec(data, grid, axis, glen)
+
+
+def iota(grid: ProcGrid, axis: str, glen: int, dtype=jnp.int32,
+         block: Optional[int] = None) -> DistVec:
+    """0..glen-1 (≅ FullyDistVec::iota)."""
+    v = constant(grid, axis, glen, 0, dtype, block)
+    return dataclasses.replace(v, data=v.global_index().astype(dtype))
+
+
+def from_global(grid: ProcGrid, axis: str, values, fill=0,
+                block: Optional[int] = None) -> DistVec:
+    values = jnp.asarray(values)
+    glen = values.shape[0]
+    nb = grid.pr if axis == ROW_AXIS else grid.pc
+    block = block or _ceil_div(glen, nb)
+    pad = nb * block - glen
+    data = jnp.pad(values, (0, pad), constant_values=fill).reshape(nb, block)
+    data = jax.device_put(data, grid.sharding(axis, None))
+    return DistVec(data, grid, axis, glen)
+
+
+def realign(v: DistVec, axis: str, block: Optional[int] = None,
+            fill=0) -> DistVec:
+    """Re-align a vector to the other mesh axis (≅ TransposeVector,
+    ParFriends.h:1388). On square grids with matching blocks this is a
+    pure resharding; otherwise re-blocks through the logical length,
+    padding with ``fill``."""
+    nb = v.grid.pr if axis == ROW_AXIS else v.grid.pc
+    if block is None:
+        block = _ceil_div(v.glen, nb) if axis != v.axis else v.block
+    if axis == v.axis and block == v.block:
+        return v
+    flat = v.data.reshape(-1)[:v.glen]
+    flat = jnp.pad(flat, (0, nb * block - v.glen), constant_values=fill)
+    data = flat.reshape(nb, block)
+    data = jax.lax.with_sharding_constraint(data, v.grid.sharding(axis, None))
+    return DistVec(data, v.grid, axis, v.glen)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DistSpVec:
+    """Sparse distributed vector = dense values + activity mask
+    (≅ FullyDistSpVec; sparsity-as-masking, see module docstring)."""
+
+    data: Array                      # (nblocks, block) values
+    active: Array                    # (nblocks, block) bool
+    grid: ProcGrid = dataclasses.field(metadata=dict(static=True))
+    axis: str = dataclasses.field(metadata=dict(static=True))
+    glen: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def dense(self) -> DistVec:
+        return DistVec(self.data, self.grid, self.axis, self.glen)
+
+    def getnnz(self) -> Array:
+        live = self.active & self.dense.valid_mask()
+        return jnp.sum(live)
+
+    def map(self, fn) -> "DistSpVec":
+        return dataclasses.replace(self, data=fn(self.data))
+
+    def to_global(self) -> tuple[np.ndarray, np.ndarray]:
+        d = np.asarray(self.data).reshape(-1)[:self.glen]
+        a = np.asarray(self.active).reshape(-1)[:self.glen]
+        return d, a
+
+
+def sp_from_dense_mask(v: DistVec, active: Array) -> DistSpVec:
+    return DistSpVec(v.data, active, v.grid, v.axis, v.glen)
+
+
+def sp_realign(v: DistSpVec, axis: str, block: Optional[int] = None,
+               fill=0) -> DistSpVec:
+    dv = realign(v.dense, axis, block, fill)
+    am = realign(DistVec(v.active, v.grid, v.axis, v.glen), axis, block,
+                 False)
+    return DistSpVec(dv.data, am.data, v.grid, axis, v.glen)

@@ -1,0 +1,98 @@
+"""The 2D (and 3D) process grid as a JAX device mesh.
+
+Capability parity: `CommGrid` (CommGrid.h:44) builds a √p×√p grid with
+row/col/diag sub-communicators and rank↔(i,j) arithmetic;
+`CommGrid3D` (CommGrid3D.h:9) adds layers. `ProductGrid`
+(src/CommGrid.cpp:164) checks grid compatibility for C = A·B and
+returns the number of SUMMA stages.
+
+TPU-native re-design: a `jax.sharding.Mesh` with named axes replaces
+communicators entirely — "the row world" is simply collectives over
+axis "c" (within a row, across columns), "the column world" axis "r",
+and the diagonal is positional arithmetic on (r, c) indices inside
+shard_map. Rank math, sub-communicator bookkeeping, and the MPI
+type/op caches (MPIType.h, MPIOp.h) have no equivalent: sharding
+specs and monoid collectives replace them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROW_AXIS = "r"   # first mesh axis: which block-row a device owns
+COL_AXIS = "c"   # second mesh axis: which block-column
+LAYER_AXIS = "l"  # third mesh axis (3D grids): replication layer
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcGrid:
+    """A 2D device grid; the CommGrid equivalent.
+
+    ``mesh`` has axes (ROW_AXIS, COL_AXIS) of shape (pr, pc). A device
+    at mesh position (i, j) owns block-row i and block-column j of any
+    matrix distributed on this grid.
+    """
+
+    mesh: Mesh
+
+    @staticmethod
+    def make(pr: Optional[int] = None, pc: Optional[int] = None,
+             devices: Optional[Sequence] = None) -> "ProcGrid":
+        """Build a grid over ``devices`` (default: all). With no shape
+        given, picks the squarest pr×pc factorization of the device
+        count (the reference requires perfectly square p; a mesh does
+        not, but SpGEMM's stage structure still prefers square)."""
+        devices = list(devices if devices is not None else jax.devices())
+        p = len(devices)
+        if pr is None and pc is None:
+            pr = int(math.isqrt(p))
+            while p % pr:
+                pr -= 1
+            pc = p // pr
+        elif pr is None:
+            pr = p // pc
+        elif pc is None:
+            pc = p // pr
+        if pr * pc != p:
+            raise ValueError(f"grid {pr}x{pc} != {p} devices")
+        arr = np.array(devices).reshape(pr, pc)
+        return ProcGrid(Mesh(arr, (ROW_AXIS, COL_AXIS)))
+
+    @property
+    def pr(self) -> int:
+        return self.mesh.shape[ROW_AXIS]
+
+    @property
+    def pc(self) -> int:
+        return self.mesh.shape[COL_AXIS]
+
+    @property
+    def square(self) -> bool:
+        return self.pr == self.pc
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    # -- SUMMA compatibility (≅ ProductGrid, src/CommGrid.cpp:164) ---------
+    def stages_with(self, other: "ProcGrid") -> int:
+        if self.mesh.devices.shape != other.mesh.devices.shape or \
+           (self.mesh.devices != other.mesh.devices).any():
+            raise ValueError("GRIDMISMATCH: operands on different grids")
+        if not self.square:
+            raise ValueError("SUMMA requires a square grid (pr == pc)")
+        return self.pc
+
+    def __hash__(self):
+        return hash((self.mesh.devices.shape,
+                     tuple(d.id for d in self.mesh.devices.flat)))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcGrid)
+                and self.mesh.devices.shape == other.mesh.devices.shape
+                and (self.mesh.devices == other.mesh.devices).all())

@@ -1,0 +1,97 @@
+"""Distributed SpMV / SpMSpV over the grid.
+
+Capability parity: the 4-phase sparse SpMV of ParFriends.h:1725
+(TransposeVector → AllGatherVector → LocalSpMV → Alltoallv+Merge), the
+dense-vector SpMV (ParFriends.h:1925), and the BFS-specialized variant
+(BFSFriends.h:328).
+
+TPU-native re-design: with vectors stored dense-with-mask and
+replicated along the perpendicular mesh axis (see distvec.py), the
+four phases collapse to:
+
+    realign (pure resharding; ≅ TransposeVector+AllGather fan-out)
+    → per-tile gather/multiply/segment-reduce (≅ LocalSpMV)
+    → monoid collective along the row's devices (≅ Alltoallv fan-in
+      + MergeContributions, but as one `psum`/`pmax`-family op on ICI)
+
+No host round-trips, no dynamic shapes; the semiring's add monoid
+picks the collective (MPIOp.h's functor→MPI_Op map, reborn).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops.semiring import Semiring
+from combblas_tpu.parallel.distmat import DistSpMat
+from combblas_tpu.parallel.distvec import DistVec, DistSpVec, realign, sp_realign
+from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
+
+
+def _check_aligned(a: DistSpMat, x: DistVec):
+    if x.axis != COL_AXIS:
+        raise ValueError("x must be column-aligned (use realign)")
+    if x.block != a.tile_n or x.nblocks != a.grid.pc:
+        raise ValueError(
+            f"x blocks ({x.nblocks},{x.block}) do not match matrix tiles "
+            f"({a.grid.pc},{a.tile_n})")
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def spmv(sr: Semiring, a: DistSpMat, x: DistVec) -> DistVec:
+    """y = A ⊗ x (dense-vector SpMV, ≅ ParFriends.h:1925)."""
+    _check_aligned(a, x)
+    mesh = a.grid.mesh
+
+    def f(rows, cols, vals, nnz, xb):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        y = tl.spmv(sr, t, xb[0])
+        return sr.add.axis_reduce(y, COL_AXIS)[None]
+
+    data = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 3
+                 + (P(ROW_AXIS, COL_AXIS), P(COL_AXIS, None)),
+        out_specs=P(ROW_AXIS, None),
+    )(a.rows, a.cols, a.vals, a.nnz, x.data)
+    return DistVec(data, a.grid, ROW_AXIS, a.nrows)
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
+    """y = A ⊗ x with sparse (masked) x — SpMSpV (≅ ParFriends.h:1725 /
+    BFSFriends.h:328). Output activity = rows that received any
+    contribution."""
+    _check_aligned(a, x.dense)
+    mesh = a.grid.mesh
+
+    def f(rows, cols, vals, nnz, xb, actb):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        y = tl.spmv_masked(sr, t, xb[0], actb[0])
+        # hit mask: any active in-edge (boolean OR over contributions)
+        v = t.valid()
+        cg = jnp.clip(t.cols, 0, t.ncols - 1)
+        act = actb[0][cg] & v
+        hits = jax.ops.segment_max(
+            act.astype(jnp.int32), jnp.where(act, t.rows, t.nrows),
+            t.nrows, indices_are_sorted=True) > 0
+        y = sr.add.axis_reduce(y, COL_AXIS)
+        hits = lax.pmax(hits.astype(jnp.int32), COL_AXIS) > 0
+        return y[None], hits[None]
+
+    data, active = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 3
+                 + (P(ROW_AXIS, COL_AXIS), P(COL_AXIS, None), P(COL_AXIS, None)),
+        out_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None)),
+    )(a.rows, a.cols, a.vals, a.nnz, x.data, x.active)
+    return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)

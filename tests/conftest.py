@@ -8,7 +8,7 @@ devices over which every mesh/collective path executes for real.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"   # force off the real-TPU tunnel
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +16,12 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+# The environment's sitecustomize imports jax and initializes the real-TPU
+# backend before this file runs; clear it so the env above takes effect.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+_xb._clear_backends()
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,63 @@
+"""BFS: self-validating app runs (the TopDownBFS validation pattern,
+TopDownBFS.cpp:452-524) on the emulated mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.models import bfs as B
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid22():
+    return ProcGrid.make(2, 2, jax.devices()[:4])
+
+
+def build_sym(edges, n, grid):
+    r = np.array([e[0] for e in edges] + [e[1] for e in edges], np.int32)
+    c = np.array([e[1] for e in edges] + [e[0] for e in edges], np.int32)
+    a = DM.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones(len(r), jnp.bool_), n, n)
+    return a, r, c
+
+
+class TestBFS:
+    def test_path_graph(self, grid22):
+        n = 10
+        edges = [(i, i + 1) for i in range(n - 1)]
+        a, r, c = build_sym(edges, n, grid22)
+        parents = B.bfs(a, 0).to_global()
+        info = B.validate_bfs(r, c, n, 0, parents)
+        assert info["visited"] == n and info["depth"] == n - 1
+        np.testing.assert_array_equal(parents, [0] + list(range(n - 1)))
+
+    def test_disconnected(self, grid22):
+        edges = [(0, 1), (1, 2), (4, 5)]
+        a, r, c = build_sym(edges, 7, grid22)
+        parents = B.bfs(a, 0).to_global()
+        info = B.validate_bfs(r, c, 7, 0, parents)
+        assert info["visited"] == 3
+        assert parents[4] == -1 and parents[5] == -1 and parents[6] == -1
+
+    def test_star(self, grid22):
+        edges = [(0, i) for i in range(1, 9)]
+        a, r, c = build_sym(edges, 9, grid22)
+        parents = B.bfs(a, 3).to_global()
+        info = B.validate_bfs(r, c, 9, 3, parents)
+        assert info["visited"] == 9 and info["depth"] == 2
+
+    def test_rmat_scale8_validated(self, grid22):
+        stats = B.graph500_run(grid22, scale=8, edgefactor=8, nroots=4,
+                               validate=True)
+        assert len(stats.teps) == 4
+        assert min(stats.visited) > 0
+
+    def test_rmat_nonsquare_grid(self):
+        grid = ProcGrid.make()  # 2x4
+        stats = B.graph500_run(grid, scale=7, edgefactor=8, nroots=3,
+                               validate=True)
+        assert len(stats.teps) == 3

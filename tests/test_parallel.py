@@ -1,0 +1,150 @@
+"""Distributed layer on the 8-device CPU mesh: golden-model comparisons
+(the MultTest/ReduceTest pattern) with real collectives executing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import distvec as DV
+from combblas_tpu.parallel import spmv as SPMV
+from combblas_tpu.parallel import spgemm as SPG
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+
+@pytest.fixture(scope="module")
+def grid24():
+    return ProcGrid.make()          # 8 devices -> 2x4
+
+
+@pytest.fixture(scope="module")
+def grid22():
+    return ProcGrid.make(2, 2, jax.devices()[:4])
+
+
+def random_sparse(rng, m, n, density=0.25):
+    d = rng.random((m, n)).astype(np.float32)
+    d[rng.random((m, n)) > density] = 0.0
+    return d
+
+
+class TestGrid:
+    def test_make_shapes(self, grid24, grid22):
+        assert (grid24.pr, grid24.pc) == (2, 4)
+        assert grid22.square and grid22.stages_with(grid22) == 2
+
+    def test_grid_mismatch(self, grid24, grid22):
+        with pytest.raises(ValueError):
+            grid24.stages_with(grid22)
+
+
+class TestDistMat:
+    def test_roundtrip(self, rng, grid24):
+        d = random_sparse(rng, 21, 30)   # deliberately not divisible by 2/4
+        a = DM.from_dense(S.PLUS, grid24, d, 0.0)
+        np.testing.assert_array_equal(DM.to_dense(a, 0.0), d)
+        assert a.getnnz() == np.count_nonzero(d)
+
+    def test_transpose_square_grid(self, rng, grid22):
+        d = random_sparse(rng, 10, 14)
+        a = DM.from_dense(S.PLUS, grid22, d, 0.0)
+        np.testing.assert_array_equal(DM.to_dense(DM.transpose(a), 0.0), d.T)
+
+    def test_dedup_on_build(self, grid24):
+        rows = np.array([0, 0, 5], np.int32)
+        cols = np.array([1, 1, 5], np.int32)
+        vals = jnp.asarray([1.0, 2.0, 7.0], jnp.float32)
+        a = DM.from_global_coo(S.PLUS, grid24, rows, cols, vals, 8, 8)
+        d = DM.to_dense(a, 0.0)
+        assert d[0, 1] == 3.0 and d[5, 5] == 7.0 and a.getnnz() == 2
+
+
+class TestDistVec:
+    def test_iota_reduce(self, grid24):
+        v = DV.iota(grid24, ROW_AXIS, 13)
+        assert v.to_global().tolist() == list(range(13))
+        assert int(v.reduce(S.PLUS)) == sum(range(13))
+        assert int(v.reduce(S.MAX)) == 12
+
+    def test_realign_square(self, grid22):
+        v = DV.from_global(grid22, ROW_AXIS, jnp.arange(10, dtype=jnp.float32))
+        w = DV.realign(v, COL_AXIS)
+        assert w.axis == COL_AXIS
+        np.testing.assert_array_equal(w.to_global(), v.to_global())
+
+
+class TestSpMV:
+    @pytest.mark.parametrize("sr,zero", [
+        (S.PLUS_TIMES_F32, 0.0), (S.MIN_PLUS_F32, np.inf)])
+    def test_vs_dense(self, rng, grid24, sr, zero):
+        m, n = 19, 27
+        d = random_sparse(rng, m, n)
+        if np.isinf(zero):
+            d[d == 0] = np.inf
+        a = DM.from_dense(sr.add, grid24, d, zero)
+        xv = rng.random(n).astype(np.float32)
+        x = DV.from_global(grid24, COL_AXIS, jnp.asarray(xv),
+                           fill=zero, block=a.tile_n)
+        y = SPMV.spmv(sr, a, x)
+        if np.isinf(zero):
+            expect = np.min(np.where(np.isinf(d), np.inf, d)
+                            + xv[None, :], axis=1)
+        else:
+            expect = d @ xv
+        np.testing.assert_allclose(y.to_global(), expect, rtol=1e-5)
+
+    def test_spmsv_bfs_step(self, rng, grid22):
+        n = 16
+        d = (random_sparse(rng, n, n, 0.3) != 0).astype(np.int32)
+        a = DM.from_dense(S.MAX, grid22, jnp.asarray(d), 0)
+        ident = np.iinfo(np.int32).min
+        xv = np.full(n, ident, np.int64)
+        act = np.zeros(n, bool)
+        act[[3, 7]] = True
+        xv[3], xv[7] = 3, 7
+        x = DV.from_global(grid22, COL_AXIS, jnp.asarray(xv, jnp.int32),
+                           fill=ident, block=a.tile_n)
+        sx = DV.sp_from_dense_mask(x, DV.from_global(
+            grid22, COL_AXIS, jnp.asarray(act), fill=False,
+            block=a.tile_n).data)
+        y = SPMV.spmsv(S.SELECT2ND_MAX_I32, a, sx)
+        yd, ya = y.to_global()
+        expect = np.full(n, ident, np.int64)
+        for i in range(n):
+            src = [v for v in (3, 7) if d[i, v]]
+            if src:
+                expect[i] = max(src)
+        np.testing.assert_array_equal(yd, expect)
+        np.testing.assert_array_equal(ya, expect != ident)
+
+
+class TestSUMMA:
+    @pytest.mark.parametrize("sr,zero", [
+        (S.PLUS_TIMES_F32, 0.0), (S.MIN_PLUS_F32, np.inf)])
+    def test_vs_dense(self, rng, grid22, sr, zero):
+        m, k, n = 14, 10, 12
+        da = random_sparse(rng, m, k, 0.3)
+        db = random_sparse(rng, k, n, 0.3)
+        if np.isinf(zero):
+            da[da == 0] = np.inf
+            db[db == 0] = np.inf
+        a = DM.from_dense(sr.add, grid22, da, zero)
+        b = DM.from_dense(sr.add, grid22, db, zero)
+        fc, oc = SPG.plan_spgemm(a, b)
+        c = SPG.summa(sr, a, b, flops_cap=fc, out_cap=oc)
+        got = DM.to_dense(c, zero)
+        expect = np.asarray(S.dense_matmul(sr, jnp.asarray(da), jnp.asarray(db)))
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_square_of_permutation(self, grid22):
+        # permutation matrices: structure-only correctness
+        n = 8
+        perm = np.random.default_rng(3).permutation(n)
+        d = np.zeros((n, n), np.float32)
+        d[np.arange(n), perm] = 1.0
+        a = DM.from_dense(S.PLUS, grid22, d, 0.0)
+        fc, oc = SPG.plan_spgemm(a, a)
+        c = SPG.summa(S.PLUS_TIMES_F32, a, a, flops_cap=fc, out_cap=oc)
+        np.testing.assert_array_equal(DM.to_dense(c, 0.0), d @ d)
