@@ -41,6 +41,9 @@ def main():
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / BASELINE_GTEPS, 3),
+        "baseline": f"{BASELINE_GTEPS} GTEPS median, Graph500 scale-22 "
+                    "ef16, 64 MPI ranks (CarverResults/scale22_p64_july11"
+                    ".run)",
     }))
 
 

@@ -161,6 +161,9 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     n = 1 << scale
     r, c = generate.rmat_edges(kgen, scale, edgefactor)
     r, c = generate.symmetrize(r, c)
+    # initial cap is a guess from the average tile; from_global_coo
+    # detects overflow against the true per-tile counts and re-plans
+    # with an exact cap (no silent edge dropping under R-MAT skew)
     a = dm.from_global_coo(S.LOR, grid, r, c,
                            jnp.ones_like(r, jnp.bool_), n, n,
                            cap=int(cap_slack * (r.shape[0] //

@@ -257,6 +257,10 @@ def dense_matmul(sr: Semiring, a: Array, b: Array, k_block: int = 128) -> Array:
         ablk = lax.dynamic_slice(a, (0, i * k_block), (m, k_block))
         bblk = lax.dynamic_slice(b, (i * k_block, 0), (k_block, n))
         prod = sr.multiply(ablk[:, :, None], bblk[None, :, :])
+        # mask padded k-lanes explicitly: user multiplies need not
+        # annihilate the identity (e.g. int min_plus: MAX+x wraps)
+        kvalid = i * k_block + jnp.arange(k_block) < k
+        prod = jnp.where(kvalid[None, :, None], prod, ident)
         return sr.add.combine(acc, sr.add.reduce(prod, axis=1))
 
     acc0 = jnp.full((m, n), ident)

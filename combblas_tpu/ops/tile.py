@@ -113,18 +113,21 @@ def empty(nrows: int, ncols: int, cap: int, dtype=jnp.float32) -> Tile:
 # Construction (≅ SpTuples -> SpDCCols conversion: sort + dedup, SpTuples.h:88)
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("add", "nrows", "ncols", "cap", "dedup"))
+@partial(jax.jit, static_argnames=("add", "nrows", "ncols", "cap", "dedup",
+                                   "return_full"))
 def from_coo(add: Monoid, rows: Array, cols: Array, vals: Array,
              *, nrows: int, ncols: int, cap: int,
-             valid: Optional[Array] = None, dedup: bool = True) -> Tile:
+             valid: Optional[Array] = None, dedup: bool = True,
+             return_full: bool = False):
     """Build a sorted, deduplicated tile from unordered COO triples.
 
     Duplicates are combined with the ``add`` monoid (the reference's
     `BinOp` dedup in SpTuples.h:88). ``valid`` masks input entries;
     invalid and overflow (> cap live entries) are dropped — overflow
-    drops the *largest* coordinates (callers should size cap from
-    `spgemm_flops`-style oracles; `nnz` reports the true live count
-    clamped to cap).
+    drops the *largest* coordinates. With ``return_full=True`` also
+    returns the pre-clamp live count so callers can *detect* overflow
+    and re-plan (the realloc-on-demand semantics of SpTuples.h:88;
+    see distmat.from_global_coo for the grow loop).
     """
     rows = rows.astype(jnp.int32)
     cols = cols.astype(jnp.int32)
@@ -168,7 +171,8 @@ def from_coo(add: Monoid, rows: Array, cols: Array, vals: Array,
     nnz = jnp.minimum(nnz_full, cap)
     srows = jnp.where(keep, srows, nrows)
     scols = jnp.where(keep, scols, ncols)
-    return Tile(srows, scols, vals, nnz, nrows, ncols)
+    t = Tile(srows, scols, vals, nnz, nrows, ncols)
+    return (t, nnz_full) if return_full else t
 
 
 @partial(jax.jit, static_argnames=("cap",))
@@ -252,14 +256,19 @@ def spmv(sr: Semiring, t: Tile, x: Array) -> Array:
 
 
 def spmv_masked(sr: Semiring, t: Tile, x: Array, x_active: Array) -> Array:
-    """SpMSpV with an explicit activity mask on x (fringe semantics)."""
+    """SpMSpV with an explicit activity mask on x (fringe semantics).
+
+    Inactive entries contribute the add identity under their *true* row
+    id — a no-op by the monoid law — so segment ids stay the tile's
+    sorted rows and `indices_are_sorted` is legitimately true (masking
+    interior ids to nrows would break sortedness: XLA scatter UB).
+    """
     v = t.valid()
     cg = jnp.clip(t.cols, 0, t.ncols - 1)
     act = x_active[cg] & v
     contrib = sr.multiply(t.vals, x[cg])
     contrib = jnp.where(act, contrib, sr.add.identity(contrib.dtype))
-    segs = jnp.where(act, t.rows, t.nrows)
-    return sr.add.segment_reduce(contrib, segs, t.nrows, sorted_ids=True)
+    return sr.add.segment_reduce(contrib, t.rows, t.nrows, sorted_ids=True)
 
 
 # ---------------------------------------------------------------------------
@@ -295,15 +304,29 @@ def spgemm(sr: Semiring, a: Tile, b: Tile, *, flops_cap: int, out_cap: int,
     the capacity of the result tile.
     """
     assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
+    _SAT = 2**30 - 1
+    if flops_cap > _SAT:
+        raise ValueError(
+            f"flops_cap {flops_cap} > 2^30-1: expansion indices saturate — "
+            "bound the per-call flop budget by splitting the multiply into "
+            "phases (see parallel.spgemm)")
     bptr = row_starts(b)
     acol = jnp.clip(a.cols, 0, a.ncols - 1)
     per = jnp.where(a.valid(), bptr[acol + 1] - bptr[acol], 0)
-    offs = jnp.cumsum(per) - per           # exclusive prefix
-    total = offs[-1] + per[-1]
+    # Saturating inclusive prefix (min(a+b, 2^30-1) is associative for
+    # nonnegatives ≤ 2^30-1): the true total flops can exceed int32 even
+    # when flops_cap is small, and a wrapped cumsum would silently
+    # corrupt the searchsorted mapping. Saturation keeps the prefix
+    # exact below 2^30 (≥ flops_cap, so every kept slot is exact) and
+    # monotone above (those slots are dropped anyway).
+    per = jnp.minimum(per, _SAT)
+    incl = lax.associative_scan(lambda x, y: jnp.minimum(x + y, _SAT), per)
+    offs = jnp.concatenate([jnp.zeros((1,), per.dtype), incl[:-1]])
+    total = incl[-1]
 
     slots = jnp.arange(flops_cap, dtype=jnp.int32)
     # which a-entry does slot s expand? last e with offs[e] <= s
-    e = jnp.searchsorted(offs + per, slots, side="right").astype(jnp.int32)
+    e = jnp.searchsorted(incl, slots, side="right").astype(jnp.int32)
     e = jnp.clip(e, 0, a.cap - 1)
     live = slots < total
     t = slots - offs[e]

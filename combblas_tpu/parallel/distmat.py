@@ -109,23 +109,26 @@ def _build_tiles(add, grid, rows, cols, vals, nrows, ncols, cap, dedup):
         mine = (rows // tile_m == i) & (cols // tile_n == j)
         return tl.from_coo(add, rows - i * tile_m, cols - j * tile_n, vals,
                            nrows=tile_m, ncols=tile_n, cap=cap,
-                           valid=mine, dedup=dedup)
-    batched = jax.vmap(one)(ti, tj)
+                           valid=mine, dedup=dedup, return_full=True)
+    batched, full = jax.vmap(one)(ti, tj)
     return (batched.rows.reshape(pr, pc, cap),
             batched.cols.reshape(pr, pc, cap),
             batched.vals.reshape(pr, pc, cap),
-            batched.nnz.reshape(pr, pc))
+            batched.nnz.reshape(pr, pc),
+            full.reshape(pr, pc))
 
 
 def from_global_coo(add: Monoid, grid: ProcGrid, rows, cols, vals,
                     nrows: int, ncols: int, cap: Optional[int] = None,
-                    dedup: bool = True) -> DistSpMat:
+                    dedup: bool = True, grow: bool = True) -> DistSpMat:
     """Distribute a global COO edge/triple list onto the grid.
 
     The owner of (r, c) is tile (r // tile_m, c // tile_n) — block
     distribution as in the reference (Owner, SpParMat.h:210). ``cap``
-    is the shared per-tile capacity (default: a uniform bound from the
-    input length with 2x slack for imbalance).
+    is the shared per-tile capacity; if any tile's true (deduplicated)
+    entry count exceeds it, the build **re-plans with an exact cap**
+    (grow=True, the realloc-on-demand semantics of SpTuples.h:88) or
+    raises (grow=False). No silent entry dropping, ever.
     """
     rows = jnp.asarray(rows, jnp.int32)
     cols = jnp.asarray(cols, jnp.int32)
@@ -134,8 +137,19 @@ def from_global_coo(add: Monoid, grid: ProcGrid, rows, cols, vals,
         per = _ceil_div(int(rows.shape[0]), grid.pr * grid.pc)
         cap = min(int(rows.shape[0]),
                   max(64, 2 * per))
-    r, c, v, nnz = _build_tiles(add, grid, rows, cols, vals,
-                                nrows, ncols, cap, dedup)
+    r, c, v, nnz, full = _build_tiles(add, grid, rows, cols, vals,
+                                      nrows, ncols, cap, dedup)
+    max_full = int(np.asarray(full).max())
+    if max_full > cap:
+        if not grow:
+            raise ValueError(
+                f"tile overflow: a tile holds {max_full} entries > cap "
+                f"{cap}; pass a larger cap or grow=True")
+        # exact re-plan: nnz_full is the true per-tile count (dedup runs
+        # before the clamp), so one rebuild always suffices
+        cap = -(-max_full // 128) * 128  # lane-aligned
+        r, c, v, nnz, full = _build_tiles(add, grid, rows, cols, vals,
+                                          nrows, ncols, cap, dedup)
     shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
     shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
     return DistSpMat(

@@ -77,12 +77,15 @@ def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
         t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
                     a.tile_m, a.tile_n)
         y = tl.spmv_masked(sr, t, xb[0], actb[0])
-        # hit mask: any active in-edge (boolean OR over contributions)
+        # hit mask: any active in-edge (boolean OR over contributions).
+        # Segment ids are the tile's sorted rows (padding rows == nrows
+        # drop out); inactive entries contribute 0, the OR identity — so
+        # indices_are_sorted is legitimately true.
         v = t.valid()
         cg = jnp.clip(t.cols, 0, t.ncols - 1)
         act = actb[0][cg] & v
         hits = jax.ops.segment_max(
-            act.astype(jnp.int32), jnp.where(act, t.rows, t.nrows),
+            act.astype(jnp.int32), t.rows,
             t.nrows, indices_are_sorted=True) > 0
         y = sr.add.axis_reduce(y, COL_AXIS)
         hits = lax.pmax(hits.astype(jnp.int32), COL_AXIS) > 0

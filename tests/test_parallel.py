@@ -52,6 +52,30 @@ class TestDistMat:
         a = DM.from_dense(S.PLUS, grid22, d, 0.0)
         np.testing.assert_array_equal(DM.to_dense(DM.transpose(a), 0.0), d.T)
 
+    def test_overflow_raises_without_grow(self, grid24):
+        # every entry lands in tile (0, 0): worst-case imbalance
+        n = 32
+        rows = np.arange(8, dtype=np.int32) % 4
+        cols = np.arange(8, dtype=np.int32) % 4
+        vals = jnp.arange(8, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="overflow"):
+            DM.from_global_coo(S.PLUS, grid24, rows, cols, vals, n, n,
+                               cap=2, grow=False)
+
+    def test_overflow_grows_no_data_loss(self, grid24):
+        # skewed input (all in one tile) with a too-small cap must
+        # re-plan and keep every entry (no silent dropping)
+        n = 32
+        rng = np.random.default_rng(7)
+        rows = rng.integers(0, 4, 200).astype(np.int32)
+        cols = rng.integers(0, 4, 200).astype(np.int32)
+        vals = jnp.ones(200, jnp.float32)
+        a = DM.from_global_coo(S.PLUS, grid24, rows, cols, vals, n, n, cap=2)
+        expect = np.zeros((n, n), np.float32)
+        np.add.at(expect, (rows, cols), 1.0)
+        np.testing.assert_array_equal(DM.to_dense(a, 0.0), expect)
+        assert a.getnnz() == np.count_nonzero(expect)
+
     def test_dedup_on_build(self, grid24):
         rows = np.array([0, 0, 5], np.int32)
         cols = np.array([1, 1, 5], np.int32)

@@ -55,6 +55,21 @@ class TestRoundTrip:
         t = T.from_coo(S.PLUS, rows, cols, vals, nrows=10, ncols=10, cap=4)
         assert int(t.nnz) == 4
 
+    def test_overflow_is_detectable(self, rng):
+        # return_full exposes the pre-clamp live count (the overflow
+        # signal replacing the reference's realloc, SpTuples.h:88)
+        rows = jnp.arange(10, dtype=jnp.int32)
+        cols = jnp.arange(10, dtype=jnp.int32)
+        vals = jnp.ones((10,), jnp.float32)
+        t, full = T.from_coo(S.PLUS, rows, cols, vals, nrows=10, ncols=10,
+                             cap=4, return_full=True)
+        assert int(t.nnz) == 4 and int(full) == 10
+        # dedup happens before the clamp: duplicates don't inflate full
+        t2, full2 = T.from_coo(S.PLUS, jnp.zeros(10, jnp.int32),
+                               jnp.zeros(10, jnp.int32), vals,
+                               nrows=10, ncols=10, cap=4, return_full=True)
+        assert int(full2) == 1 and int(t2.nnz) == 1
+
 
 class TestStructural:
     def test_transpose(self, rng):
@@ -165,6 +180,12 @@ class TestRegressions:
         t = T.from_dense(jnp.asarray(d), jnp.asarray(0.0, jnp.float32), 30)
         assert t.cap == 30 and int(t.nnz) == 4
         np.testing.assert_array_equal(np.asarray(T.to_dense(t, 0.0)), d)
+
+    def test_flops_cap_guard(self, rng):
+        d = random_sparse(rng, 8, 8)
+        t = make_tile(d)
+        with pytest.raises(ValueError, match="2\\^30"):
+            T.spgemm(S.PLUS_TIMES_F32, t, t, flops_cap=2**30, out_cap=64)
 
     def test_flops_host_int64(self, rng):
         d = np.ones((40, 40), np.float32)
