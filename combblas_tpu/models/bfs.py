@@ -1,16 +1,34 @@
-"""Graph500 breadth-first search.
+"""Graph500 breadth-first search, direction-optimizing.
 
-Capability parity: Applications/TopDownBFS.cpp — generate→symmetricize→
+Capability parity: Applications/TopDownBFS.cpp (generate→symmetricize→
 per-root loop of { setNumToInd; SpMV with SelectMax semiring;
-EWiseMult(fringe, parents, exclude); parents.Set } (:437-442), plus the
-tree validation and TEPS statistics (:452-524).
+EWiseMult(fringe, parents, exclude); parents.Set } :437-442) and
+DirOptBFS.cpp (the top-down/bottom-up switch :386-409 with the
+BitMapCarousel bottom-up step BFSFriends.h:458), plus tree validation
+and TEPS statistics (TopDownBFS.cpp:452-524).
 
-TPU-native re-design: the whole per-root BFS is ONE jitted
-`lax.while_loop` with zero host round-trips (the BASELINE.json north
-star). The fringe is a masked dense vector (distvec design note), so
-`setNumToInd` is an iota, `EWiseMult(..., exclude)` is a mask-and, and
-`parents.Set` is a `where`. The SpMV fan-in/fan-out runs on mesh
-collectives via parallel.spmv.spmsv.
+TPU-native re-design. The whole per-root BFS is ONE jitted
+`lax.while_loop` with zero host round-trips. Each level picks one of
+two steppers via `lax.cond` (the direction-optimizing switch):
+
+* **dense step** (heavy levels; plays the role of the reference's
+  bottom-up scan): one full pass over the tile's sorted edges — gather
+  frontier bits at the source columns, contribute the *global column
+  id* where active (the index-as-value trick of ParFriends.h:1370: a
+  boolean matrix never materializes values), reduce per destination row
+  with the scatter-free segmented-scan kernel (tile.seg_reduce_sorted).
+  Cost: O(nnz) fully-vectorized VPU work, no scatter.
+
+* **sparse step** (light levels; work-proportional top-down push):
+  compact the frontier into an index list (static cap F), expand their
+  adjacency ranges from the column-sorted structure (static budget E
+  slots), and scatter-max parent ids into the fresh vector. The only
+  scatter in the program, sized E ≪ nnz.
+
+The switch predicate is exact-safe: the sparse step is chosen only
+when its static caps provably fit (per-tile frontier degree ≤ E,
+frontier size ≤ F) *and* the Beamer-style heuristic favors it
+(frontier degree · alpha < nnz, ≅ DirOptBFS.cpp:386-409).
 """
 
 from __future__ import annotations
@@ -22,13 +40,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from combblas_tpu.ops import generate
 from combblas_tpu.ops import semiring as S
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.parallel import distmat as dm
 from combblas_tpu.parallel import distvec as dv
-from combblas_tpu.parallel import spmv as pspmv
 from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
 
 # NB: python ints, NOT jnp scalars — a committed device array captured in
@@ -36,48 +54,218 @@ from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
 # backends (~400ms/call); see .claude/skills/verify/SKILL.md.
 NO_PARENT = -1
 _IDENT = jnp.iinfo(jnp.int32).min  # add-identity of the Max monoid
+_SAT = 2**30 - 1
 
 
-@partial(jax.jit, static_argnames=())
-def bfs(a: dm.DistSpMat, root) -> dv.DistVec:
-    """Top-down BFS; returns the parents vector (r-aligned, int32).
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BfsPlan:
+    """Level-invariant traversal metadata, computed once per matrix
+    (≅ OptimizeForGraph500, SpParMat.cpp:3285). All arrays stacked
+    (pr, pc, ·) and sharded like the matrix. The dense-step arrays are
+    stored in the chunk-column layout (tile.to_chunked, flattened) so
+    no per-level transpose is needed."""
+
+    cols_t: jax.Array     # (pr, pc, capp) int32 — cols, chunked layout
+    starts_t: jax.Array   # (pr, pc, capp) bool — row-run starts, chunked
+    valid_t: jax.Array    # (pr, pc, capp) bool — live-entry mask, chunked
+    ends_m: jax.Array     # (pr, pc, tile_m) int32 — row-end offsets, mapped
+    nonempty: jax.Array   # (pr, pc, tile_m) bool
+    crows: jax.Array      # (pr, pc, cap) int32 — rows sorted by column
+    ccols: jax.Array      # (pr, pc, cap) int32 — cols sorted by column
+    cstarts: jax.Array    # (pr, pc, tile_n+1) int32 — CSC pointers
+    cdeg: jax.Array       # (pr, pc, tile_n) int32 — per-column degree
+    crun_t: jax.Array     # (pr, pc, capp) bool — column-run starts, chunked
+    c2r: jax.Array        # (pr, pc, cap) int32 — col-order -> row-order key
+
+    @property
+    def chunk_len(self) -> int:
+        return self.cols_t.shape[-1] // 128
+
+
+@jax.jit
+def plan_bfs(a: dm.DistSpMat) -> BfsPlan:
+    pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
+
+    def one(rows, cols, vals, nnz):
+        t = tl.Tile(rows, cols, vals, nnz, a.tile_m, a.tile_n)
+        starts, ends, nonempty = tl.row_structure(t)
+        valid = t.valid()
+        cols_t = tl.to_chunked(cols, fill=a.tile_n).reshape(-1)
+        starts_t = tl.to_chunked(starts, fill=True).reshape(-1)
+        valid_t = tl.to_chunked(valid, fill=False).reshape(-1)
+        ends_m = tl.chunked_pos(jnp.clip(ends, 0, cap - 1), cap)
+        crows, ccols, cstarts, cdeg, corder = tl.col_structure(t)
+        prevc = jnp.concatenate([jnp.full((1,), -1, jnp.int32), ccols[:-1]])
+        crun_t = tl.to_chunked(ccols != prevc, fill=True).reshape(-1)
+        return (cols_t, starts_t, valid_t, ends_m, nonempty,
+                crows, ccols, cstarts, cdeg, crun_t, corder)
+
+    out = jax.vmap(one)(a.rows.reshape(-1, cap), a.cols.reshape(-1, cap),
+                        a.vals.reshape(-1, cap), a.nnz.reshape(-1))
+    shard = a.grid.sharding(ROW_AXIS, COL_AXIS, None)
+    fields = [lax.with_sharding_constraint(x.reshape(pr, pc, -1), shard)
+              for x in out]
+    return BfsPlan(*fields)
+
+
+def _caps(a: dm.DistSpMat) -> list[tuple[int, int]]:
+    """Static (E, F) budget tiers for the sparse stepper, smallest
+    first. Static shapes mean a sparse level pays its whole tier's
+    gather cost even for a tiny frontier, so several tiers keep light
+    levels cheap while still covering frontiers up to ~cap/4 edges."""
+    tiers = []
+    for div in (256, 64, 16):
+        e_cap = max(1024, (a.cap // div // 128) * 128)
+        f_cap = max(128, min(a.tile_n, e_cap))
+        tiers.append((e_cap, f_cap))
+    return tiers
+
+
+@partial(jax.jit, static_argnames=("alpha",))
+def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
+        alpha: int = 8) -> dv.DistVec:
+    """Direction-optimizing BFS; returns the parents vector (r-aligned).
 
     ``a`` must hold the *incoming*-edge orientation (a[i, j] nonzero
     means edge j→i reaches i) — symmetric Graph500 graphs satisfy this
-    trivially; otherwise pass `distmat.transpose(a)` (the reference's
-    OptimizeForGraph500 does the same transpose once, SpParMat.cpp:3285).
+    trivially. Pass a precomputed ``plan`` (plan_bfs) when running many
+    roots on one matrix; otherwise it is built in-trace.
     """
+    if plan is None:
+        plan = plan_bfs(a)
     n = a.nrows
     grid = a.grid
+    mesh = grid.mesh
+    tile_m, tile_n, cap = a.tile_m, a.tile_n, a.cap
+    tiers = _caps(a)
     root = jnp.asarray(root, jnp.int32)
+    nnz_total = jnp.sum(a.nnz).astype(jnp.float32)
 
-    parents0 = jnp.full((grid.pr, a.tile_m), NO_PARENT, jnp.int32)
-    parents0 = parents0.at[root // a.tile_m, root % a.tile_m].set(root)
-    # fringe activity, column-aligned
-    act0 = jnp.zeros((grid.pc, a.tile_n), bool)
-    act0 = act0.at[root // a.tile_n, root % a.tile_n].set(True)
+    parents0 = jnp.full((grid.pr, tile_m), NO_PARENT, jnp.int32)
+    parents0 = parents0.at[root // tile_m, root % tile_m].set(root)
+    act0 = jnp.zeros((grid.pc, tile_n), bool)
+    act0 = act0.at[root // tile_n, root % tile_n].set(True)
 
-    # x values = own global vertex id (≅ fringe.setNumToInd());
-    # computed inline (trace-time), never closed-over device data
-    xval = (jnp.arange(grid.pc, dtype=jnp.int32)[:, None] * a.tile_n
-            + jnp.arange(a.tile_n, dtype=jnp.int32)[None, :])
+    spec3 = P(ROW_AXIS, COL_AXIS, None)
+    spec_act = P(COL_AXIS, None)
+    spec_y = P(ROW_AXIS, None)
+
+    capp = plan.cols_t.shape[-1]
+    chunk_len = capp // 128
+
+    # ---- dense stepper: full edge scan, gather-free -----------------------
+    # Random per-edge gathers cost ~11ns/element on TPU (serialized),
+    # so the frontier bits are instead (1) RLE-broadcast over the
+    # column-sorted edge order — one tile_n-sized scatter plus a
+    # segmented copy-scan, no random access — then (2) routed to row
+    # order by sorting against the static col→row key (~3x cheaper
+    # than the equivalent gather), then (3) max-scanned per row.
+    def dense_step(act):
+        def f(cols_t, starts_t, valid_t, ends_m, nonempty, cstarts, cdeg,
+              crun_t, c2r, actb):
+            cols_t, starts_t = cols_t[0, 0], starts_t[0, 0]
+            valid_t, ends_m, nonempty = (valid_t[0, 0], ends_m[0, 0],
+                                         nonempty[0, 0])
+            cstarts, cdeg = cstarts[0, 0], cdeg[0, 0]
+            crun_t, c2r = crun_t[0, 0], c2r[0, 0]
+            j = lax.axis_index(COL_AXIS)
+            # (1) RLE-broadcast act over column runs
+            tgt = jnp.where(cdeg > 0, cstarts[:-1], cap)
+            seed = jnp.zeros((cap + 1,), jnp.int8)
+            seed = seed.at[tgt].set(actb[0].astype(jnp.int8),
+                                    mode="drop")[:cap]
+            seed_t = tl.to_chunked(seed, fill=0)
+            eact_c, _ = tl.seg_scan_core(
+                S.MAX, seed_t, crun_t.reshape(chunk_len, 128))
+            # (2) route bits to row order: sort by the static key
+            _, eact_r = lax.sort(
+                (c2r, eact_c.T.reshape(-1)[:cap]), num_keys=1)
+            # (3) per-row max-scan of parent candidates
+            eb = tl.to_chunked(eact_r, fill=0).reshape(-1)
+            e_act = (eb > 0) & valid_t
+            contrib = jnp.where(
+                e_act, cols_t + j.astype(jnp.int32) * tile_n, _IDENT)
+            y = tl.seg_reduce_pre(S.MAX, contrib.reshape(chunk_len, 128),
+                                  starts_t.reshape(chunk_len, 128),
+                                  ends_m, nonempty)
+            return lax.pmax(y, COL_AXIS)[None]
+
+        return jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(spec3,) * 4 + (spec3, P(ROW_AXIS, COL_AXIS, None),
+                                     spec3, spec3, spec3, spec_act),
+            out_specs=spec_y,
+        )(plan.cols_t, plan.starts_t, plan.valid_t, plan.ends_m,
+          plan.nonempty, plan.cstarts, plan.cdeg, plan.crun_t, plan.c2r,
+          act)
+
+    # ---- sparse stepper: frontier push with bounded scatter ---------------
+    # Per expanded slot: 1 gather for the base offset, 2 for the edge
+    # (dest row + parent col), 1 scatter-max — ~4 random accesses/slot
+    # vs the dense step's 1/edge, so sparse wins when the frontier
+    # degree is < nnz/alpha (alpha≈4).
+    def make_sparse_step(e_cap, f_cap):
+        def sparse_step(act):
+            def f(crows, ccols, cstarts, actb):
+                crows, ccols, cstarts = crows[0, 0], ccols[0, 0], cstarts[0, 0]
+                j = lax.axis_index(COL_AXIS)
+                idxs = jnp.nonzero(actb[0], size=f_cap,
+                                   fill_value=tile_n)[0].astype(jnp.int32)
+                safe = jnp.clip(idxs, 0, tile_n - 1)
+                st = cstarts[safe]
+                deg = jnp.where(idxs < tile_n, cstarts[safe + 1] - st, 0)
+                e_of_slot, offs, total = tl.expand_indices(deg, e_cap)
+                slots = jnp.arange(e_cap, dtype=jnp.int32)
+                e = jnp.clip(e_of_slot, 0, f_cap - 1)
+                live = slots < total
+                base = st - offs                  # (f_cap,) fused offset
+                pos = jnp.clip(base[e] + slots, 0, cap - 1)
+                nbr = crows[pos]                  # destination rows
+                par = ccols[pos] + j.astype(jnp.int32) * tile_n
+                tgt = jnp.where(live & (nbr < tile_m), nbr, tile_m)
+                fresh = jnp.full((tile_m + 1,), _IDENT, jnp.int32)
+                fresh = fresh.at[tgt].max(jnp.where(live, par, _IDENT),
+                                          mode="drop")
+                return lax.pmax(fresh[:tile_m], COL_AXIS)[None]
+
+            return jax.shard_map(
+                f, mesh=mesh,
+                in_specs=(spec3, spec3, spec3, spec_act),
+                out_specs=spec_y,
+            )(plan.crows, plan.ccols, plan.cstarts, act)
+        return sparse_step
+
+    branches = [make_sparse_step(ec, fc) for ec, fc in tiers] + [dense_step]
 
     def cond(carry):
         _, _, cont = carry
         return cont
 
     def body(carry):
-        parents, act_c, _ = carry
-        fringe = dv.DistSpVec(xval, act_c, grid, COL_AXIS, n)
-        y = pspmv.spmsv(S.SELECT2ND_MAX_I32, a, fringe)
-        fresh = y.active & (parents == NO_PARENT)
-        parents = jnp.where(fresh, y.data, parents)
-        new_r = dv.DistVec(fresh, grid, ROW_AXIS, n)
-        act_c = dv.realign(new_r, COL_AXIS, block=a.tile_n,
-                           fill=False).data
+        parents, act, _ = carry
+        # direction-optimizing switch (≅ DirOptBFS.cpp:386-409): pick
+        # the smallest sparse tier whose static budgets provably fit
+        # the frontier (per-tile degree, exact int32) — or the dense
+        # full-scan when no tier fits or sparse isn't worth it.
+        actdeg = jnp.einsum("ijk,jk->ij", plan.cdeg,
+                            act.astype(jnp.int32))
+        nact = jnp.sum(act)
+        tier_idx = jnp.int32(0)
+        for ec, fc in tiers:
+            fits = (jnp.max(actdeg) <= ec) & (nact <= fc)
+            tier_idx = tier_idx + (~fits).astype(jnp.int32)
+        worth = jnp.sum(actdeg).astype(jnp.float32) * alpha < nnz_total
+        tier_idx = jnp.where(worth, tier_idx, len(tiers))
+        y = lax.switch(tier_idx, branches, act)
+        fresh = (y != _IDENT) & (parents == NO_PARENT)
+        parents = jnp.where(fresh, y, parents)
+        act_c = dv.realign(dv.DistVec(fresh, grid, ROW_AXIS, n), COL_AXIS,
+                           block=tile_n, fill=False).data
         return parents, act_c, jnp.any(fresh)
 
-    parents, _, _ = lax.while_loop(cond, body, (parents0, act0, jnp.bool_(True)))
+    parents, _, _ = lax.while_loop(cond, body,
+                                   (parents0, act0, jnp.bool_(True)))
     return dv.DistVec(parents, grid, ROW_AXIS, n)
 
 
@@ -171,6 +359,8 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     jax.block_until_ready(a.rows)
     if verbose:
         a.print_info("A")
+    plan = plan_bfs(a)
+    jax.block_until_ready(plan.crows)
 
     # degrees for root selection (roots must have degree > 0)
     deg = np.zeros(n, np.int64)
@@ -185,10 +375,10 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
 
     stats = BfsRunStats([], [], [])
     # warm-up compile (not timed, like the reference's untimed iteration 0)
-    bfs(a, jnp.int32(roots[0])).data.block_until_ready()
+    bfs(a, jnp.int32(roots[0]), plan).data.block_until_ready()
     for root in roots:
         t0 = time.perf_counter()
-        parents = bfs(a, jnp.int32(root))
+        parents = bfs(a, jnp.int32(root), plan)
         parents.data.block_until_ready()
         dt = time.perf_counter() - t0
         pg = parents.to_global()

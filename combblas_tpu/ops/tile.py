@@ -44,9 +44,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from combblas_tpu.ops.semiring import Monoid, Semiring
+from combblas_tpu.ops.semiring import Monoid, Semiring, MAX
 
 Array = jax.Array
+
+#: saturating add for shape-oracle prefix sums: min(a+b, 2^30-1) is
+#: associative for nonnegatives below the cap, so prefixes are exact
+#: below 2^30 and monotone above (those slots are dropped anyway)
+SATADD = Monoid("satadd", lambda a, b: jnp.minimum(a + b, 2**30 - 1), 0)
 
 
 @jax.tree_util.register_dataclass
@@ -236,6 +241,176 @@ def row_starts(t: Tile) -> Array:
 
 
 # ---------------------------------------------------------------------------
+# Sorted segmented reduction without scatter (the TPU-fast local kernel)
+# ---------------------------------------------------------------------------
+#
+# XLA lowers jax.ops.segment_* to scatter, which TPUs serialize — the
+# round-1 BFS hot path spent ~all its time there. For data sorted by
+# segment (our tile invariant), a segmented reduction is instead:
+#   1. a chunk-column inclusive segmented scan: the sequence is split
+#      into C contiguous chunks laid out as the *columns* of an (L, C)
+#      array, and `lax.associative_scan` runs along axis 0 — the
+#      TPU-fast major axis (minor-axis scans/rolls cross vector lanes
+#      and are ~30x slower on real chips); a tiny (C,)-length carry
+#      scan stitches the chunk boundaries;
+#   2. one gather of each segment's last position (from row_starts).
+# No scatter anywhere.
+
+def _seg_op(monoid: Monoid):
+    def op(a, b):
+        af, ax = a
+        bf, bx = b
+        return af | bf, jnp.where(bf, bx, monoid.combine(ax, bx))
+    return op
+
+
+def to_chunked(x: Array, nchunks: int = 128, fill=0) -> Array:
+    """Lay a 1D sequence out as an (L, C) chunk-column array: column c
+    holds sequence positions c*L..(c+1)*L-1. Sequence position k lives
+    at flat offset (k % L)*C + (k // L)."""
+    n = x.shape[0]
+    L = -(-n // nchunks)
+    pad = L * nchunks - n
+    if pad:
+        x = jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+    return x.reshape(nchunks, L).T
+
+
+def chunked_pos(pos: Array, n: int, nchunks: int = 128) -> Array:
+    """Map sequence positions to flat offsets in the to_chunked layout."""
+    L = -(-n // nchunks)
+    return (pos % L) * nchunks + (pos // L)
+
+
+def seg_scan_core(monoid: Monoid, d2: Array, f2: Array):
+    """Inclusive segmented scan over a chunk-column (L, C) layout:
+    associative_scan along the TPU-fast major axis + a (C,)-length
+    carry scan stitching chunk boundaries. Returns (scanned, prefix
+    flags) both (L, C)."""
+    ident = monoid.identity(d2.dtype)
+    ff, xx = lax.associative_scan(_seg_op(monoid), (f2, d2), axis=0)
+    cf, cx = lax.associative_scan(_seg_op(monoid), (ff[-1], xx[-1]))
+    prev = jnp.concatenate([jnp.full((1,), ident, xx.dtype), cx[:-1]])
+    xx = jnp.where(ff, xx, monoid.combine(prev[None, :], xx))
+    return xx, ff
+
+
+def _seg_scan_2d(monoid: Monoid, data: Array, starts: Array,
+                 nchunks: int):
+    """Inclusive segmented scan; returns ((L, C) scanned array, L)
+    where column c holds chunk c (sequence positions c*L..c*L+L-1)."""
+    ident = monoid.identity(data.dtype)
+    d2 = to_chunked(data, nchunks, fill=ident)
+    f2 = to_chunked(starts, nchunks, fill=True)
+    xx, _ = seg_scan_core(monoid, d2, f2)
+    return xx, d2.shape[0]
+
+
+def seg_scan_inclusive(monoid: Monoid, data: Array, starts: Array,
+                       nchunks: int = 128) -> Array:
+    """Inclusive segmented scan of ``data`` (segments delimited by
+    ``starts`` flags; data[i] begins a new segment iff starts[i])."""
+    n = data.shape[0]
+    xx, L = _seg_scan_2d(monoid, data, starts, nchunks)
+    return xx.T.reshape(-1)[:n]
+
+
+def seg_reduce_sorted(monoid: Monoid, data: Array, starts: Array,
+                      seg_ends: Array, nonempty: Array,
+                      nchunks: int = 128) -> Array:
+    """Per-segment reduction of segment-sorted ``data``.
+
+    ``seg_ends[s]`` is the index of segment s's last element
+    (e.g. row_starts[s+1]-1); ``nonempty[s]`` masks segments with no
+    elements (their output is the identity). Scatter-free: segmented
+    scan + one gather straight out of the chunk-column layout.
+    """
+    n = data.shape[0]
+    xx, L = _seg_scan_2d(monoid, data, starts, nchunks)
+    pos = jnp.clip(seg_ends, 0, n - 1)
+    out = xx.ravel()[(pos % L) * nchunks + (pos // L)]
+    return jnp.where(nonempty, out, monoid.identity(data.dtype))
+
+
+def seg_reduce_pre(monoid: Monoid, d2: Array, f2: Array,
+                   ends_mapped: Array, nonempty: Array) -> Array:
+    """seg_reduce_sorted for inputs already in the chunk-column layout
+    (data and flags via `to_chunked`, positions via `chunked_pos`) —
+    the zero-copy per-level path when the layout is precomputed."""
+    xx, _ = seg_scan_core(monoid, d2, f2)
+    out = xx.ravel()[jnp.clip(ends_mapped, 0, xx.size - 1)]
+    return jnp.where(nonempty, out, monoid.identity(d2.dtype))
+
+
+def scan_inclusive(monoid: Monoid, data: Array, nchunks: int = 128) -> Array:
+    """Unsegmented inclusive scan via the chunk-column layout (avoids
+    jnp.cumsum / minor-axis scans, both serialized on TPU)."""
+    starts = jnp.zeros(data.shape, bool).at[0].set(True)
+    return seg_scan_inclusive(monoid, data, starts, nchunks)
+
+
+def expand_indices(counts: Array, nslots: int):
+    """Run-length-decode: entry e with counts[e]>0 owns slots
+    [offs[e], offs[e]+counts[e]); returns (e_of_slot, offs, total)
+    where e_of_slot[s] is the owning entry (-1 before the first run).
+
+    This is the shape-bounded expansion at the heart of ESC SpGEMM and
+    frontier push. Implemented as one small scatter (len(counts)) plus
+    a max-scan — NOT searchsorted, whose binary-search while-loop
+    dominates the profile on TPU.
+    """
+    counts = jnp.minimum(counts, 2**30 - 1)
+    incl = scan_inclusive(SATADD, counts)
+    offs = jnp.concatenate([jnp.zeros((1,), counts.dtype), incl[:-1]])
+    total = incl[-1]
+    nent = counts.shape[0]
+    tgt = jnp.where((counts > 0) & (offs < nslots), offs, nslots)
+    marks = jnp.full((nslots + 1,), -1, jnp.int32)
+    marks = marks.at[tgt].max(jnp.arange(nent, dtype=jnp.int32),
+                              mode="drop")[:nslots]
+    e_of_slot = scan_inclusive(MAX, marks)
+    return e_of_slot, offs, total
+
+
+@jax.jit
+def row_structure(t: Tile):
+    """Level-invariant row-segment metadata for `seg_reduce_sorted`:
+    (starts flags over cap, seg_ends over nrows, nonempty over nrows).
+    Compute once per matrix, reuse every SpMV/BFS level."""
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t.rows[:-1]])
+    starts = t.rows != prev
+    rst = row_starts(t)
+    seg_ends = rst[1:] - 1
+    nonempty = rst[1:] > rst[:-1]
+    return starts, seg_ends, nonempty
+
+
+@jax.jit
+def col_structure(t: Tile):
+    """Column-sorted view for frontier-driven (push) traversal:
+    (crows, ccols, cstarts, cdeg) where crows/ccols list the entries
+    sorted by (col, row), cstarts is the CSC-style column pointer
+    (ncols+1,), and cdeg the per-column degree. ≅ building the
+    transpose's CSR — the reference keeps DCSC per orientation for the
+    same reason."""
+    v = t.valid()
+    sc = jnp.where(v, t.cols, t.ncols)
+    srw = jnp.where(v, t.rows, t.nrows)
+    order = jnp.lexsort((srw, sc)).astype(jnp.int32)
+    crows = srw[order]
+    ccols = sc[order]
+    cstarts = jnp.searchsorted(
+        ccols, jnp.arange(t.ncols + 1, dtype=jnp.int32),
+        side="left").astype(jnp.int32)
+    cdeg = cstarts[1:] - cstarts[:-1]
+    # order[k] = row-sorted position of col-sorted entry k: sorting a
+    # payload by this key routes col-order data back to row order (the
+    # permute-by-sort trick: lax.sort is ~3x faster than an nnz-sized
+    # random gather on TPU)
+    return crows, ccols, cstarts, cdeg, order
+
+
+# ---------------------------------------------------------------------------
 # SpMV / SpMSpV (≅ Friends.h:64 dcsc_gespmv, SpImpl.h kernels)
 # ---------------------------------------------------------------------------
 
@@ -251,24 +426,24 @@ def spmv(sr: Semiring, t: Tile, x: Array) -> Array:
     xg = x[jnp.clip(t.cols, 0, t.ncols - 1)]
     contrib = sr.multiply(t.vals, xg)
     contrib = jnp.where(v, contrib, sr.add.identity(contrib.dtype))
-    segs = jnp.where(v, t.rows, t.nrows)
-    return sr.add.segment_reduce(contrib, segs, t.nrows, sorted_ids=True)
+    starts, seg_ends, nonempty = row_structure(t)
+    return seg_reduce_sorted(sr.add, contrib, starts, seg_ends, nonempty)
 
 
 def spmv_masked(sr: Semiring, t: Tile, x: Array, x_active: Array) -> Array:
     """SpMSpV with an explicit activity mask on x (fringe semantics).
 
-    Inactive entries contribute the add identity under their *true* row
-    id — a no-op by the monoid law — so segment ids stay the tile's
-    sorted rows and `indices_are_sorted` is legitimately true (masking
-    interior ids to nrows would break sortedness: XLA scatter UB).
+    Inactive entries contribute the add identity — a no-op by the
+    monoid law — and the reduction runs over the tile's sorted row
+    segments via the scatter-free scan kernel.
     """
     v = t.valid()
     cg = jnp.clip(t.cols, 0, t.ncols - 1)
     act = x_active[cg] & v
     contrib = sr.multiply(t.vals, x[cg])
     contrib = jnp.where(act, contrib, sr.add.identity(contrib.dtype))
-    return sr.add.segment_reduce(contrib, t.rows, t.nrows, sorted_ids=True)
+    starts, seg_ends, nonempty = row_structure(t)
+    return seg_reduce_sorted(sr.add, contrib, starts, seg_ends, nonempty)
 
 
 # ---------------------------------------------------------------------------
@@ -313,21 +488,9 @@ def spgemm(sr: Semiring, a: Tile, b: Tile, *, flops_cap: int, out_cap: int,
     bptr = row_starts(b)
     acol = jnp.clip(a.cols, 0, a.ncols - 1)
     per = jnp.where(a.valid(), bptr[acol + 1] - bptr[acol], 0)
-    # Saturating inclusive prefix (min(a+b, 2^30-1) is associative for
-    # nonnegatives ≤ 2^30-1): the true total flops can exceed int32 even
-    # when flops_cap is small, and a wrapped cumsum would silently
-    # corrupt the searchsorted mapping. Saturation keeps the prefix
-    # exact below 2^30 (≥ flops_cap, so every kept slot is exact) and
-    # monotone above (those slots are dropped anyway).
-    per = jnp.minimum(per, _SAT)
-    incl = lax.associative_scan(lambda x, y: jnp.minimum(x + y, _SAT), per)
-    offs = jnp.concatenate([jnp.zeros((1,), per.dtype), incl[:-1]])
-    total = incl[-1]
-
+    e_of_slot, offs, total = expand_indices(per, flops_cap)
     slots = jnp.arange(flops_cap, dtype=jnp.int32)
-    # which a-entry does slot s expand? last e with offs[e] <= s
-    e = jnp.searchsorted(incl, slots, side="right").astype(jnp.int32)
-    e = jnp.clip(e, 0, a.cap - 1)
+    e = jnp.clip(e_of_slot, 0, a.cap - 1)
     live = slots < total
     t = slots - offs[e]
     bidx = jnp.clip(bptr[jnp.clip(a.cols[e], 0, a.ncols - 1)] + t, 0, b.cap - 1)

@@ -181,6 +181,48 @@ class TestRegressions:
         assert t.cap == 30 and int(t.nnz) == 4
         np.testing.assert_array_equal(np.asarray(T.to_dense(t, 0.0)), d)
 
+    def test_seg_scan_matches_numpy(self, rng):
+        # segmented scan / reduce vs a numpy golden model, sizes that
+        # are not multiples of the 128 block
+        for n, nseg in [(5, 2), (300, 7), (1000, 50)]:
+            data = rng.integers(-50, 50, n).astype(np.int32)
+            ids = np.sort(rng.integers(0, nseg, n)).astype(np.int32)
+            starts = np.ones(n, bool)
+            starts[1:] = ids[1:] != ids[:-1]
+            got = T.seg_scan_inclusive(S.MAX, jnp.asarray(data),
+                                       jnp.asarray(starts))
+            expect = data.copy()
+            for i in range(1, n):
+                if not starts[i]:
+                    expect[i] = max(expect[i - 1], expect[i])
+            np.testing.assert_array_equal(np.asarray(got), expect)
+            # per-segment reduce
+            ends = np.searchsorted(ids, np.arange(nseg), side="right") - 1
+            nonempty = np.array([(ids == s).any() for s in range(nseg)])
+            red = T.seg_reduce_sorted(S.MAX, jnp.asarray(data),
+                                      jnp.asarray(starts),
+                                      jnp.asarray(ends.astype(np.int32)),
+                                      jnp.asarray(nonempty))
+            ident = np.iinfo(np.int32).min
+            expect_red = np.full(nseg, ident, np.int32)
+            np.maximum.at(expect_red, ids, data)
+            np.testing.assert_array_equal(np.asarray(red), expect_red)
+
+    def test_row_col_structure(self, rng):
+        d = random_sparse(rng, 12, 9, 0.4)
+        t = make_tile(d, cap=160)
+        starts, ends, nonempty = T.row_structure(t)
+        crows, ccols, cstarts, cdeg, corder = T.col_structure(t)
+        # permute-by-sort key routes col-order data back to row order
+        rr = np.asarray(t.rows)
+        np.testing.assert_array_equal(rr[np.asarray(corder)],
+                                      np.asarray(crows))
+        np.testing.assert_array_equal(np.asarray(cdeg),
+                                      (d != 0).sum(axis=0))
+        for j in range(9):
+            got = np.sort(np.asarray(crows)[cstarts[j]:cstarts[j + 1]])
+            np.testing.assert_array_equal(got, np.nonzero(d[:, j])[0])
+
     def test_flops_cap_guard(self, rng):
         d = random_sparse(rng, 8, 8)
         t = make_tile(d)
