@@ -8,8 +8,9 @@ BitMapCarousel bottom-up step BFSFriends.h:458), plus tree validation
 and TEPS statistics (TopDownBFS.cpp:452-524).
 
 TPU-native re-design. The whole per-root BFS is ONE jitted
-`lax.while_loop` with zero host round-trips. Each level picks one of
-two steppers via `lax.cond` (the direction-optimizing switch):
+`lax.while_loop` with zero host round-trips. Each level picks a
+stepper via `lax.switch` over the sparse budget tiers plus the dense
+fallback (the direction-optimizing switch):
 
 * **dense step** (heavy levels; plays the role of the reference's
   bottom-up scan): one full pass over the tile's sorted edges — gather
@@ -77,6 +78,10 @@ class BfsPlan:
     cdeg: jax.Array       # (pr, pc, tile_n) int32 — per-column degree
     crun_t: jax.Array     # (pr, pc, capp) bool — column-run starts, chunked
     c2r: jax.Array        # (pr, pc, cap) int32 — col-order -> row-order key
+    # consistency token: the source matrix's static signature. A plan is
+    # valid ONLY for the exact matrix it was built from (same tiles, same
+    # nnz, same entry order); `bfs` asserts the static part at trace time.
+    sig: tuple = dataclasses.field(default=(), metadata=dict(static=True))
 
     @property
     def chunk_len(self) -> int:
@@ -106,14 +111,15 @@ def plan_bfs(a: dm.DistSpMat) -> BfsPlan:
     shard = a.grid.sharding(ROW_AXIS, COL_AXIS, None)
     fields = [lax.with_sharding_constraint(x.reshape(pr, pc, -1), shard)
               for x in out]
-    return BfsPlan(*fields)
+    return BfsPlan(*fields, sig=(pr, pc, cap, a.tile_m, a.tile_n))
 
 
 def _caps(a: dm.DistSpMat) -> list[tuple[int, int]]:
     """Static (E, F) budget tiers for the sparse stepper, smallest
     first. Static shapes mean a sparse level pays its whole tier's
     gather cost even for a tiny frontier, so several tiers keep light
-    levels cheap while still covering frontiers up to ~cap/4 edges."""
+    levels cheap while still covering frontiers up to ~cap/16 edges
+    (heavier frontiers take the dense full scan)."""
     tiers = []
     for div in (256, 64, 16):
         e_cap = max(1024, (a.cap // div // 128) * 128)
@@ -131,9 +137,21 @@ def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
     means edge j→i reaches i) — symmetric Graph500 graphs satisfy this
     trivially. Pass a precomputed ``plan`` (plan_bfs) when running many
     roots on one matrix; otherwise it is built in-trace.
+
+    INVARIANT: a supplied ``plan`` must have been built by `plan_bfs`
+    from this exact ``a`` (same tiles AND same entry content) — a stale
+    plan after rebuilding ``a`` silently yields wrong parents. The
+    static signature (grid/cap/tile dims) is asserted at trace time;
+    the content identity cannot be checked cheaply and is on the caller.
     """
     if plan is None:
         plan = plan_bfs(a)
+    elif plan.sig and plan.sig != (a.grid.pr, a.grid.pc, a.cap,
+                                   a.tile_m, a.tile_n):
+        raise ValueError(
+            f"BfsPlan signature {plan.sig} does not match matrix "
+            f"{(a.grid.pr, a.grid.pc, a.cap, a.tile_m, a.tile_n)}: the "
+            "plan was built for a different matrix (plan_bfs(a) rebuilds)")
     n = a.nrows
     grid = a.grid
     mesh = grid.mesh
@@ -204,7 +222,7 @@ def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
     # Per expanded slot: 1 gather for the base offset, 2 for the edge
     # (dest row + parent col), 1 scatter-max — ~4 random accesses/slot
     # vs the dense step's 1/edge, so sparse wins when the frontier
-    # degree is < nnz/alpha (alpha≈4).
+    # degree is < nnz/alpha (alpha defaults to 8).
     def make_sparse_step(e_cap, f_cap):
         def sparse_step(act):
             def f(crows, ccols, cstarts, actb):
@@ -250,10 +268,14 @@ def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
         # full-scan when no tier fits or sparse isn't worth it.
         actdeg = jnp.einsum("ijk,jk->ij", plan.cdeg,
                             act.astype(jnp.int32))
-        nact = jnp.sum(act)
+        # the sparse stepper compacts each column *block* separately, so
+        # the F-cap constraint is the per-block max active count, not
+        # the global frontier size (a wide low-degree frontier spread
+        # over pc blocks stays eligible for the sparse tiers)
+        nact_blk = jnp.max(jnp.sum(act, axis=1))
         tier_idx = jnp.int32(0)
         for ec, fc in tiers:
-            fits = (jnp.max(actdeg) <= ec) & (nact <= fc)
+            fits = (jnp.max(actdeg) <= ec) & (nact_blk <= fc)
             tier_idx = tier_idx + (~fits).astype(jnp.int32)
         worth = jnp.sum(actdeg).astype(jnp.float32) * alpha < nnz_total
         tier_idx = jnp.where(worth, tier_idx, len(tiers))

@@ -437,13 +437,26 @@ def spmv_masked(sr: Semiring, t: Tile, x: Array, x_active: Array) -> Array:
     monoid law — and the reduction runs over the tile's sorted row
     segments via the scatter-free scan kernel.
     """
+    y, _ = spmv_masked_hits(sr, t, x, x_active)
+    return y
+
+
+def spmv_masked_hits(sr: Semiring, t: Tile, x: Array,
+                     x_active: Array) -> tuple[Array, Array]:
+    """`spmv_masked` plus the per-row hit mask (any active in-edge),
+    sharing one gather and one row-structure pass. Both reductions run
+    the scatter-free segmented-scan kernel — no jax.ops.segment_* on
+    this path (TPUs serialize scatter)."""
     v = t.valid()
     cg = jnp.clip(t.cols, 0, t.ncols - 1)
     act = x_active[cg] & v
     contrib = sr.multiply(t.vals, x[cg])
     contrib = jnp.where(act, contrib, sr.add.identity(contrib.dtype))
     starts, seg_ends, nonempty = row_structure(t)
-    return seg_reduce_sorted(sr.add, contrib, starts, seg_ends, nonempty)
+    y = seg_reduce_sorted(sr.add, contrib, starts, seg_ends, nonempty)
+    hits = seg_reduce_sorted(MAX, act.astype(jnp.int32), starts, seg_ends,
+                             nonempty) > 0
+    return y, hits
 
 
 # ---------------------------------------------------------------------------

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops.semiring import Semiring
 from combblas_tpu.parallel.distmat import DistSpMat
-from combblas_tpu.parallel.distvec import DistVec, DistSpVec, realign, sp_realign
+from combblas_tpu.parallel.distvec import DistVec, DistSpVec, realign
 from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
 
 
@@ -76,17 +76,9 @@ def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
     def f(rows, cols, vals, nnz, xb, actb):
         t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
                     a.tile_m, a.tile_n)
-        y = tl.spmv_masked(sr, t, xb[0], actb[0])
-        # hit mask: any active in-edge (boolean OR over contributions).
-        # Segment ids are the tile's sorted rows (padding rows == nrows
-        # drop out); inactive entries contribute 0, the OR identity — so
-        # indices_are_sorted is legitimately true.
-        v = t.valid()
-        cg = jnp.clip(t.cols, 0, t.ncols - 1)
-        act = actb[0][cg] & v
-        hits = jax.ops.segment_max(
-            act.astype(jnp.int32), t.rows,
-            t.nrows, indices_are_sorted=True) > 0
+        # value + hit-mask reductions share one gather/row-structure pass,
+        # both on the scatter-free segmented-scan kernel
+        y, hits = tl.spmv_masked_hits(sr, t, xb[0], actb[0])
         y = sr.add.axis_reduce(y, COL_AXIS)
         hits = lax.pmax(hits.astype(jnp.int32), COL_AXIS) > 0
         return y[None], hits[None]
