@@ -1,0 +1,368 @@
+"""Tile-level matrix algebra: reductions, apply/prune, k-select, EWise.
+
+Capability parity: the local bodies behind the reference's matrix
+algebra surface — `Reduce` (SpParMat.cpp:886 walks local columns),
+`Apply/Prune/PruneI/PruneColumn` (SpParMat.h:147-195, dcsc.h:92-97),
+`Kselect1` per-column top-k (SpParMat.cpp:1191), `DimApply`
+(SpParMat.h:108), and the Dcsc-level `EWiseMult`/`EWiseApply`/
+`SetDifference` (Friends.h:748-1300).
+
+TPU-native re-design: every op is a fully-vectorized pass over the
+sorted-COO tile — keep-mask compaction replaces the reference's
+realloc-and-copy loops, per-column ranking replaces its per-column
+heap selection, and the two-tile EWise family is one tagged
+concat+sort+adjacent-pair pass instead of a two-pointer merge loop.
+All outputs keep the static-capacity invariant (ops.tile docstring).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from combblas_tpu.ops.semiring import Monoid, Semiring
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops.tile import Tile
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Keep-mask compaction (the shared body of the prune/EWise family)
+# ---------------------------------------------------------------------------
+
+def compact(t: Tile, keep: Array, cap: Optional[int] = None) -> Tile:
+    """New tile holding exactly the entries where ``keep`` is set.
+
+    ``keep`` must be False at padding. The stable live-first partition
+    preserves (row, col) sortedness, so no re-sort is needed — this is
+    the vectorized replacement for the reference's copy-compaction
+    loops (e.g. Dcsc::Prune, dcsc.cpp).
+    """
+    cap = t.cap if cap is None else cap
+    order = jnp.argsort(~keep, stable=True)
+    keep_s = keep[order]
+    rows = jnp.where(keep_s, t.rows[order], t.nrows)
+    cols = jnp.where(keep_s, t.cols[order], t.ncols)
+    vals = t.vals[order]
+    out = Tile(rows, cols, vals, jnp.sum(keep).astype(jnp.int32),
+               t.nrows, t.ncols)
+    return out.with_capacity(cap) if cap != t.cap else out
+
+
+# ---------------------------------------------------------------------------
+# Reduce / Apply / Prune / DimApply (SpParMat.h:147-195 local bodies)
+# ---------------------------------------------------------------------------
+
+def reduce_rows(monoid: Monoid, t: Tile, map_val: Callable = None) -> Array:
+    """Per-row reduction -> (nrows,): out[i] = fold(monoid, vals in row i).
+
+    ``map_val`` optionally transforms each value before folding (the
+    `__unary_op` of SpParMat::Reduce). Rows with no entries hold the
+    identity. Runs on the scatter-free segmented-scan kernel (the tile
+    is row-sorted).
+    """
+    v = t.valid()
+    vals = map_val(t.vals) if map_val is not None else t.vals
+    vals = jnp.where(v, vals, monoid.identity(vals.dtype))
+    starts, seg_ends, nonempty = tl.row_structure(t)
+    return tl.seg_reduce_sorted(monoid, vals, starts, seg_ends, nonempty)
+
+
+def reduce_cols(monoid: Monoid, t: Tile, map_val: Callable = None) -> Array:
+    """Per-column reduction -> (ncols,) (≅ Reduce(Column), SpParMat.cpp:886).
+
+    Sorts by column once, then runs the same scatter-free kernel the
+    row path uses.
+    """
+    v = t.valid()
+    vals = map_val(t.vals) if map_val is not None else t.vals
+    vals = jnp.where(v, vals, monoid.identity(vals.dtype))
+    sc = jnp.where(v, t.cols, t.ncols)
+    order = jnp.argsort(sc)          # stable not needed: fold is commutative
+    sc = sc[order]
+    vals = vals[order]
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), sc[:-1]])
+    starts = sc != prev
+    cptr = jnp.searchsorted(sc, jnp.arange(t.ncols + 1, dtype=jnp.int32),
+                            side="left").astype(jnp.int32)
+    seg_ends = cptr[1:] - 1
+    nonempty = cptr[1:] > cptr[:-1]
+    return tl.seg_reduce_sorted(monoid, vals, starts, seg_ends, nonempty)
+
+
+def reduce(monoid: Monoid, t: Tile, dim: str,
+           map_val: Callable = None) -> Array:
+    """dim="row": out[i] over row i (length nrows); dim="col": out[j]
+    over column j (length ncols)."""
+    if dim == "row":
+        return reduce_rows(monoid, t, map_val)
+    if dim == "col":
+        return reduce_cols(monoid, t, map_val)
+    raise ValueError(f"dim must be 'row' or 'col', got {dim!r}")
+
+
+def apply(t: Tile, fn: Callable[[Array], Array]) -> Tile:
+    """Elementwise value transform on live entries (≅ SpParMat::Apply)."""
+    import dataclasses
+    vals = jnp.where(t.valid(), fn(t.vals), t.vals)
+    return dataclasses.replace(t, vals=vals)
+
+
+def prune(t: Tile, pred: Callable[[Array], Array],
+          cap: Optional[int] = None) -> Tile:
+    """Remove entries whose value satisfies ``pred`` (≅ Prune,
+    SpParMat.h:174: "prune all entries whose predicate evaluates true")."""
+    keep = t.valid() & ~pred(t.vals)
+    return compact(t, keep, cap)
+
+
+def prune_i(t: Tile, pred: Callable[[Array, Array, Array], Array],
+            cap: Optional[int] = None,
+            row_offset=0, col_offset=0) -> Tile:
+    """Prune with an index-aware predicate pred(i, j, v) on *global*
+    coordinates (≅ PruneI, SpParMat.h:180); offsets place the tile in
+    the global matrix."""
+    gi = t.rows + jnp.asarray(row_offset, jnp.int32)
+    gj = t.cols + jnp.asarray(col_offset, jnp.int32)
+    keep = t.valid() & ~pred(gi, gj, t.vals)
+    return compact(t, keep, cap)
+
+
+def prune_column(t: Tile, thresh: Array,
+                 pred: Callable[[Array, Array], Array],
+                 cap: Optional[int] = None) -> Tile:
+    """Per-column pruning: drop entry (i,j,v) iff pred(v, thresh[j])
+    (≅ PruneColumn, SpParMat.h:190 / dcsc.h:96). ``thresh`` is a dense
+    (ncols,) vector."""
+    cg = jnp.clip(t.cols, 0, t.ncols - 1)
+    keep = t.valid() & ~pred(t.vals, thresh[cg])
+    return compact(t, keep, cap)
+
+
+def dim_apply(t: Tile, dim: str, vec: Array,
+              fn: Callable[[Array, Array], Array]) -> Tile:
+    """v_ij <- fn(v_ij, vec[i]) (dim="row") or fn(v_ij, vec[j])
+    (dim="col") (≅ DimApply, SpParMat.h:108 — e.g. column scaling for
+    MakeColStochastic, MCL.cpp:390)."""
+    import dataclasses
+    if dim == "row":
+        g = vec[jnp.clip(t.rows, 0, t.nrows - 1)]
+    elif dim == "col":
+        g = vec[jnp.clip(t.cols, 0, t.ncols - 1)]
+    else:
+        raise ValueError(f"dim must be 'row' or 'col', got {dim!r}")
+    vals = jnp.where(t.valid(), fn(t.vals, g), t.vals)
+    return dataclasses.replace(t, vals=vals)
+
+
+# ---------------------------------------------------------------------------
+# Per-column k-select (≅ Kselect1, SpParMat.cpp:1191)
+# ---------------------------------------------------------------------------
+
+def kselect_col(t: Tile, k, fill) -> Array:
+    """Per-column k-th largest value -> (ncols,); columns with fewer
+    than k entries get ``fill``.
+
+    One sort by (col asc, val desc) + a rank gather — the vectorized
+    replacement for the reference's per-column selection. ``k`` may be
+    traced (clamped to >= 1). The returned thresholds feed
+    `prune_column` to keep each column's top-k (ties keep extras, as
+    in the reference's threshold-based PruneColumn usage).
+    """
+    return kselect_cols_raw(t.cols, t.vals, t.valid(), t.ncols, k, fill)
+
+
+def kselect_cols_raw(cols: Array, vals: Array, valid: Array, ncols: int,
+                     k, fill) -> Array:
+    """`kselect_col` on raw (cols, vals, valid) arrays — the body is
+    separate so the distributed Kselect1 can run it on an all-gathered
+    multi-tile column slice (parallel.algebra.kselect1)."""
+    k = jnp.maximum(jnp.asarray(k, jnp.int32), 1)
+    n = cols.shape[0]
+    sc = jnp.where(valid, cols, ncols)
+    # ascending (col, val) sort; the k-th largest of column j is then at
+    # cptr[j+1]-k — no value negation (exact for every dtype)
+    order = jnp.lexsort((vals, sc))
+    sc_s = sc[order]
+    vals_s = vals[order]
+    cptr = jnp.searchsorted(sc_s, jnp.arange(ncols + 1, dtype=jnp.int32),
+                            side="left").astype(jnp.int32)
+    pos = cptr[1:] - k                           # rank-k position per column
+    has_k = pos >= cptr[:-1]                     # column has >= k entries
+    out = vals_s[jnp.clip(pos, 0, n - 1)]
+    return jnp.where(has_k, out, jnp.asarray(fill, vals.dtype))
+
+
+def nnz_per_column(t: Tile) -> Array:
+    """(ncols,) live-entry count per column (≅ Reduce(Column, plus, 1))."""
+    v = t.valid()
+    sc = jnp.where(v, t.cols, t.ncols)
+    cptr = jnp.searchsorted(jnp.sort(sc),
+                            jnp.arange(t.ncols + 1, dtype=jnp.int32),
+                            side="left").astype(jnp.int32)
+    return cptr[1:] - cptr[:-1]
+
+
+def nnz_per_row(t: Tile) -> Array:
+    """(nrows,) live-entry count per row (tile is row-sorted: free)."""
+    rst = tl.row_starts(t)
+    return rst[1:] - rst[:-1]
+
+
+# ---------------------------------------------------------------------------
+# Two-tile EWise family (≅ Friends.h:748-1300, ParFriends.h:2157-2243)
+# ---------------------------------------------------------------------------
+#
+# All three ops share one skeleton: tag-concat the two sorted tiles,
+# sort by (row, col, tag), and classify each position as a *pair first*
+# (same coordinate as the next position — the A entry), *pair second*
+# (the matching B entry), or a singleton of either side. Tiles are
+# duplicate-free, so at most two entries share a coordinate and pairs
+# are adjacent with A first.
+
+def _ewise_classify(a: Tile, b: Tile):
+    assert a.nrows == b.nrows and a.ncols == b.ncols, "DIMMISMATCH"
+    va, vb = a.valid(), b.valid()
+    rows = jnp.concatenate([jnp.where(va, a.rows, a.nrows),
+                            jnp.where(vb, b.rows, b.nrows)])
+    cols = jnp.concatenate([jnp.where(va, a.cols, a.ncols),
+                            jnp.where(vb, b.cols, b.ncols)])
+    tag = jnp.concatenate([jnp.zeros((a.cap,), jnp.int32),
+                           jnp.ones((b.cap,), jnp.int32)])
+    valid = jnp.concatenate([va, vb])
+    order = jnp.lexsort((tag, cols, rows))
+    rows, cols, tag, valid = rows[order], cols[order], tag[order], valid[order]
+    nxt_same = jnp.concatenate([
+        (rows[1:] == rows[:-1]) & (cols[1:] == cols[:-1]),
+        jnp.zeros((1,), bool)])
+    pair_first = nxt_same & valid                 # A entry with B match
+    pair_second = jnp.concatenate([jnp.zeros((1,), bool),
+                                   pair_first[:-1]])
+    return rows, cols, tag, valid, order, pair_first, pair_second
+
+
+def _gathered_vals(a: Tile, b: Tile, order: Array) -> Array:
+    vals = jnp.concatenate([a.vals, b.vals.astype(a.vals.dtype)])
+    return vals[order]
+
+
+def ewise_mult(sr_multiply: Callable[[Array, Array], Array],
+               a: Tile, b: Tile, exclude: bool = False,
+               cap: Optional[int] = None) -> Tile:
+    """exclude=False: intersection A .* B with ``sr_multiply``;
+    exclude=True: entries of A whose coordinate is NOT in B (the BFS
+    fringe masking op — ≅ EWiseMult(exclude), ParFriends.h:2174).
+    Result has A's value dtype."""
+    rows, cols, tag, valid, order, pf, ps = _ewise_classify(a, b)
+    vals = _gathered_vals(a, b, order)
+    if exclude:
+        keep = valid & (tag == 0) & ~pf
+        out_vals = vals
+    else:
+        nxt = jnp.concatenate([vals[1:], vals[:1]])
+        out_vals = sr_multiply(vals, nxt)
+        keep = pf
+    cap = cap if cap is not None else a.cap
+    return compact(Tile(jnp.where(valid, rows, a.nrows),
+                        jnp.where(valid, cols, a.ncols),
+                        out_vals, jnp.sum(valid).astype(jnp.int32),
+                        a.nrows, a.ncols),
+                   keep, cap)
+
+
+def set_difference(a: Tile, b: Tile, cap: Optional[int] = None) -> Tile:
+    """A \\ B on coordinates (≅ SetDifference, ParFriends.h:2157)."""
+    return ewise_mult(lambda x, y: x, a, b, exclude=True, cap=cap)
+
+
+def ewise_apply(a: Tile, b: Tile, fn: Callable[[Array, Array], Array],
+                *, allow_a_null: bool = False, allow_b_null: bool = False,
+                a_null=0, b_null=0, cap: Optional[int] = None,
+                out_dtype=None, pass_presence: bool = False) -> Tile:
+    """General union/intersection EWise (≅ EWiseApply with null
+    handling, ParFriends.h:2194-2243):
+
+      * coordinate in both:      fn(va, vb)
+      * only in A:               fn(va, b_null)  if allow_b_null else drop
+      * only in B:               fn(a_null, vb)  if allow_a_null else drop
+
+    With ``pass_presence=True``, ``fn(va, vb, a_has, b_has)`` also
+    receives boolean presence flags (the extended predicate form of the
+    reference's EWiseApply) so asymmetric merges can distinguish "only
+    in B" from "B holds the null value".
+    """
+    rows, cols, tag, valid, order, pf, ps = _ewise_classify(a, b)
+    vals = _gathered_vals(a, b, order)
+    out_dtype = out_dtype or a.dtype
+    nxt = jnp.concatenate([vals[1:], vals[:1]])
+    an = jnp.asarray(a_null, vals.dtype)
+    bn = jnp.asarray(b_null, vals.dtype)
+    only_a = valid & (tag == 0) & ~pf
+    only_b = valid & (tag == 1) & ~ps
+    if pass_presence:
+        def call(va, vb, ah, bh):
+            return fn(va, vb, ah, bh).astype(out_dtype)
+        out_vals = jnp.where(
+            pf, call(vals, nxt, True, True),
+            jnp.where(only_a, call(vals, bn, True, False),
+                      call(an, vals, False, True)))
+    else:
+        out_vals = jnp.where(
+            pf, fn(vals, nxt).astype(out_dtype),
+            jnp.where(only_a, fn(vals, bn).astype(out_dtype),
+                      fn(an, vals).astype(out_dtype)))
+    keep = pf
+    if allow_b_null:
+        keep = keep | only_a
+    if allow_a_null:
+        keep = keep | only_b
+    # default capacity never drops: union output can reach a.nnz + b.nnz
+    cap = cap if cap is not None else (
+        a.cap + b.cap if (allow_a_null or allow_b_null) else max(a.cap, b.cap))
+    return compact(Tile(jnp.where(valid, rows, a.nrows),
+                        jnp.where(valid, cols, a.ncols),
+                        out_vals, jnp.sum(valid).astype(jnp.int32),
+                        a.nrows, a.ncols),
+                   keep, cap)
+
+
+# ---------------------------------------------------------------------------
+# Column slice / concat (≅ Dcsc::ColSplit/ColConcatenate, dcsc.h:101-105 —
+# the local bodies of phased SpGEMM, ParFriends.h:555)
+# ---------------------------------------------------------------------------
+
+def col_slice(t: Tile, lo: int, hi: int, cap: int) -> Tile:
+    """Columns [lo, hi) as a new (nrows, hi-lo) tile (cols shifted)."""
+    keep = t.valid() & (t.cols >= lo) & (t.cols < hi)
+    ncols_new = hi - lo
+    shifted = Tile(t.rows, jnp.where(keep, t.cols - lo, ncols_new),
+                   t.vals, t.nnz, t.nrows, ncols_new)
+    return compact(shifted, keep, cap)
+
+
+def col_concat(tiles: list, cap: int) -> Tile:
+    """Concatenate tiles horizontally (inverse of `col_slice` splits).
+
+    Entries are disjoint by construction (distinct column ranges), so
+    this is a merge without dedup."""
+    nrows = tiles[0].nrows
+    offs = []
+    total = 0
+    for t in tiles:
+        assert t.nrows == nrows, "DIMMISMATCH"
+        offs.append(total)
+        total += t.ncols
+    rows = jnp.concatenate([t.rows for t in tiles])
+    cols = jnp.concatenate(
+        [jnp.where(t.valid(), t.cols + off, total)
+         for t, off in zip(tiles, offs)])
+    vals = jnp.concatenate([t.vals for t in tiles])
+    valid = jnp.concatenate([t.valid() for t in tiles])
+    from combblas_tpu.ops.semiring import PLUS
+    return tl.from_coo(PLUS, rows, cols, vals, nrows=nrows, ncols=total,
+                       cap=cap, valid=valid, dedup=False)

@@ -1,0 +1,348 @@
+"""Distributed matrix algebra over the 2D grid.
+
+Capability parity: the SpParMat algebra surface — `Reduce(dim)`
+(SpParMat.cpp:886), `Apply/Prune/PruneI/PruneColumn` (SpParMat.h:
+147-195), `Kselect1` (SpParMat.cpp:1191), `DimApply` (SpParMat.h:108),
+`MaskedReduce` (:142), `RemoveLoops/AddLoops` (SpParMat.h:153-155),
+and the aligned-matrix EWise ops `EWiseMult/EWiseApply/SetDifference`
+(ParFriends.h:2157-2243).
+
+TPU-native re-design: local bodies are the vectorized tile ops
+(ops.tile_algebra) vmapped over the (pr, pc) tile grid; the
+cross-process combination step of each reference op becomes one
+monoid collective along a mesh axis inside shard_map (Reduce's
+row/column-world MPI_Allreduce ≅ `Monoid.axis_reduce`; Kselect's
+distributed selection ≅ an all_gather of the column slice along the
+row axis + one ranking sort).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops import tile_algebra as ta
+from combblas_tpu.ops.semiring import Monoid, Semiring
+from combblas_tpu.parallel.distmat import DistSpMat
+from combblas_tpu.parallel.distvec import DistVec
+from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
+
+Array = jax.Array
+
+
+def _rewrap(a: DistSpMat, out: tl.Tile) -> DistSpMat:
+    """Re-stack a vmapped batch of tiles ((pr*pc, cap') Tile) into the
+    grid layout of ``a``, re-asserting the grid sharding."""
+    pr, pc = a.grid.pr, a.grid.pc
+    oc = out.rows.shape[-1]
+    shard3 = a.grid.sharding(ROW_AXIS, COL_AXIS, None)
+    shard2 = a.grid.sharding(ROW_AXIS, COL_AXIS)
+    return dataclasses.replace(
+        a,
+        rows=lax.with_sharding_constraint(out.rows.reshape(pr, pc, oc), shard3),
+        cols=lax.with_sharding_constraint(out.cols.reshape(pr, pc, oc), shard3),
+        vals=lax.with_sharding_constraint(out.vals.reshape(pr, pc, oc), shard3),
+        nnz=lax.with_sharding_constraint(out.nnz.reshape(pr, pc), shard2))
+
+
+def _vmap_tiles(a: DistSpMat, fn) -> DistSpMat:
+    """Apply a Tile -> Tile op to every tile; keep grid sharding."""
+    cap = a.cap
+    batched = tl.Tile(a.rows.reshape(-1, cap), a.cols.reshape(-1, cap),
+                      a.vals.reshape(-1, cap), a.nnz.reshape(-1),
+                      a.tile_m, a.tile_n)
+    return _rewrap(a, jax.vmap(fn)(batched))
+
+
+# ---------------------------------------------------------------------------
+# Reduce (≅ SpParMat::Reduce, SpParMat.cpp:886)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("monoid", "dim", "map_val"))
+def reduce(monoid: Monoid, a: DistSpMat, dim: str,
+           map_val: Callable = None) -> DistVec:
+    """dim="row": per-row fold over all columns -> r-aligned (nrows,)
+    vector; dim="col": per-column fold -> c-aligned (ncols,) vector.
+    The local fold is the scatter-free tile kernel; the cross-tile
+    fold is the monoid's mesh collective."""
+    mesh = a.grid.mesh
+
+    def f(rows, cols, vals, nnz):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        local = ta.reduce(monoid, t, dim, map_val)
+        axis = COL_AXIS if dim == "row" else ROW_AXIS
+        return monoid.axis_reduce(local, axis)[None]
+
+    out_axis = ROW_AXIS if dim == "row" else COL_AXIS
+    data = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 3 + (P(ROW_AXIS, COL_AXIS),),
+        out_specs=P(out_axis, None),
+    )(a.rows, a.cols, a.vals, a.nnz)
+    glen = a.nrows if dim == "row" else a.ncols
+    return DistVec(data, a.grid, out_axis, glen)
+
+
+# ---------------------------------------------------------------------------
+# Apply / Prune / DimApply (local-only: no communication)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("fn",))
+def apply(a: DistSpMat, fn: Callable[[Array], Array]) -> DistSpMat:
+    """Elementwise value transform (≅ SpParMat::Apply)."""
+    return _vmap_tiles(a, lambda t: ta.apply(t, fn))
+
+
+@partial(jax.jit, static_argnames=("pred", "cap"))
+def prune(a: DistSpMat, pred: Callable[[Array], Array],
+          cap: Optional[int] = None) -> DistSpMat:
+    """Drop entries whose value satisfies ``pred`` (≅ Prune)."""
+    return _vmap_tiles(a, lambda t: ta.prune(t, pred, cap))
+
+
+@partial(jax.jit, static_argnames=("pred", "cap"))
+def prune_i(a: DistSpMat, pred, cap: Optional[int] = None) -> DistSpMat:
+    """Prune on global (i, j, v) (≅ PruneI). The per-tile global
+    offsets are reconstructed from the grid position."""
+    pr, pc, cap_in = a.grid.pr, a.grid.pc, a.cap
+    ti = jnp.repeat(jnp.arange(pr, dtype=jnp.int32), pc) * a.tile_m
+    tj = jnp.tile(jnp.arange(pc, dtype=jnp.int32), pr) * a.tile_n
+
+    def one(rows, cols, vals, nnz, ro, co):
+        t = tl.Tile(rows, cols, vals, nnz, a.tile_m, a.tile_n)
+        return ta.prune_i(t, pred, cap, row_offset=ro, col_offset=co)
+
+    batched = jax.vmap(one)(
+        a.rows.reshape(-1, cap_in), a.cols.reshape(-1, cap_in),
+        a.vals.reshape(-1, cap_in), a.nnz.reshape(-1), ti, tj)
+    return _rewrap(a, batched)
+
+
+def _is_diag(i, j, v):
+    return i == j
+
+
+def remove_loops(a: DistSpMat) -> DistSpMat:
+    """Drop diagonal entries (≅ RemoveLoops, SpParMat.h:153)."""
+    return prune_i(a, _is_diag)
+
+
+@partial(jax.jit, static_argnames=("pred", "cap"))
+def prune_column(a: DistSpMat, thresh: DistVec, pred,
+                 cap: Optional[int] = None) -> DistSpMat:
+    """Per-column prune against a c-aligned threshold vector
+    (≅ PruneColumn, SpParMat.h:190)."""
+    if thresh.axis != COL_AXIS:
+        raise ValueError("thresh must be column-aligned")
+    mesh = a.grid.mesh
+    ocap = cap if cap is not None else a.cap
+
+    def f(rows, cols, vals, nnz, th):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        out = ta.prune_column(t, th[0], pred, ocap)
+        return (out.rows[None, None], out.cols[None, None],
+                out.vals[None, None], out.nnz[None, None])
+
+    spec3 = P(ROW_AXIS, COL_AXIS, None)
+    spec2 = P(ROW_AXIS, COL_AXIS)
+    r, c, v, n = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(spec3,) * 3 + (spec2, P(COL_AXIS, None)),
+        out_specs=(spec3,) * 3 + (spec2,),
+    )(a.rows, a.cols, a.vals, a.nnz, thresh.data)
+    return dataclasses.replace(a, rows=r, cols=c, vals=v, nnz=n)
+
+
+@partial(jax.jit, static_argnames=("dim", "fn"))
+def dim_apply(a: DistSpMat, dim: str, vec: DistVec, fn) -> DistSpMat:
+    """v_ij <- fn(v_ij, vec[i or j]) with a grid-aligned vector
+    (≅ DimApply, SpParMat.h:108). dim="row" needs an r-aligned vec,
+    dim="col" a c-aligned vec."""
+    want = ROW_AXIS if dim == "row" else COL_AXIS
+    if vec.axis != want:
+        raise ValueError(f"dim_apply(dim={dim!r}) needs a {want!r}-aligned "
+                         f"vector, got {vec.axis!r}")
+    mesh = a.grid.mesh
+
+    def f(rows, cols, vals, nnz, vb):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        out = ta.dim_apply(t, dim, vb[0], fn)
+        return out.vals[None, None]
+
+    spec3 = P(ROW_AXIS, COL_AXIS, None)
+    vals = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(spec3,) * 3 + (P(ROW_AXIS, COL_AXIS), P(vec.axis, None)),
+        out_specs=spec3,
+    )(a.rows, a.cols, a.vals, a.nnz, vec.data)
+    return dataclasses.replace(a, vals=vals)
+
+
+# ---------------------------------------------------------------------------
+# Kselect (≅ Kselect1, SpParMat.cpp:1191)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def kselect1(a: DistSpMat, k, fill) -> DistVec:
+    """Per-column k-th largest value of the *global* column -> c-aligned
+    (ncols,) vector; columns with fewer than k entries get ``fill``.
+
+    Each block-column's entries live on the pr tiles of one grid
+    column; one all_gather along the row axis assembles them, then the
+    ranking sort selects rank k (exact — the reference's distributed
+    selection with a bounded all_gather instead of iterative
+    histogramming; per-device memory O(pr * cap)).
+    """
+    mesh = a.grid.mesh
+    cap = a.cap
+
+    def f(cols, vals, nnz, kk, fl):
+        gc = lax.all_gather(cols[0, 0], ROW_AXIS).reshape(-1)
+        gv = lax.all_gather(vals[0, 0], ROW_AXIS).reshape(-1)
+        gn = lax.all_gather(nnz[0, 0], ROW_AXIS)          # (pr,)
+        valid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                 < gn[:, None]).reshape(-1)
+        thr = ta.kselect_cols_raw(gc, gv, valid, a.tile_n, kk, fl)
+        return thr[None]
+
+    data = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 2
+                 + (P(ROW_AXIS, COL_AXIS), P(), P()),
+        out_specs=P(COL_AXIS, None),
+        # the result IS replicated across "r" (it derives only from
+        # all_gather(ROW_AXIS) values) but the checker can't see that
+        # through the ranking sort
+        check_vma=False,
+    )(a.cols, a.vals, a.nnz, jnp.asarray(k, jnp.int32),
+      jnp.asarray(fill, a.dtype))
+    return DistVec(data, a.grid, COL_AXIS, a.ncols)
+
+
+# ---------------------------------------------------------------------------
+# Aligned-matrix EWise family (≅ ParFriends.h:2157-2243)
+# ---------------------------------------------------------------------------
+
+def _check_same_grid(a: DistSpMat, b: DistSpMat):
+    if a.grid != b.grid or a.nrows != b.nrows or a.ncols != b.ncols \
+            or a.tile_m != b.tile_m or a.tile_n != b.tile_n:
+        raise ValueError("GRIDMISMATCH: EWise needs identically "
+                         "distributed operands")
+
+
+@partial(jax.jit, static_argnames=("mul", "exclude", "cap"))
+def ewise_mult(mul, a: DistSpMat, b: DistSpMat, exclude: bool = False,
+               cap: Optional[int] = None) -> DistSpMat:
+    """A .* B (exclude=False) or A masked by B's zero pattern
+    (exclude=True) on aligned grids (≅ EWiseMult ParFriends.h:2174).
+    Purely tile-local: alignment means no communication."""
+    _check_same_grid(a, b)
+    ocap = cap if cap is not None else a.cap
+    pr, pc = a.grid.pr, a.grid.pc
+
+    def one(ar, ac, av, an, br, bc, bv, bn):
+        at = tl.Tile(ar, ac, av, an, a.tile_m, a.tile_n)
+        bt = tl.Tile(br, bc, bv, bn, b.tile_m, b.tile_n)
+        return ta.ewise_mult(mul, at, bt, exclude=exclude, cap=ocap)
+
+    out = jax.vmap(one)(
+        a.rows.reshape(pr * pc, -1), a.cols.reshape(pr * pc, -1),
+        a.vals.reshape(pr * pc, -1), a.nnz.reshape(-1),
+        b.rows.reshape(pr * pc, -1), b.cols.reshape(pr * pc, -1),
+        b.vals.reshape(pr * pc, -1), b.nnz.reshape(-1))
+    return _rewrap(a, out)
+
+
+def _sel_first(x, y):
+    return x
+
+
+def set_difference(a: DistSpMat, b: DistSpMat,
+                   cap: Optional[int] = None) -> DistSpMat:
+    """A \\ B on coordinates (≅ SetDifference, ParFriends.h:2157)."""
+    return ewise_mult(_sel_first, a, b, exclude=True, cap=cap)
+
+
+@partial(jax.jit, static_argnames=("fn", "allow_a_null", "allow_b_null",
+                                   "cap"))
+def ewise_apply(a: DistSpMat, b: DistSpMat, fn, *,
+                allow_a_null: bool = False, allow_b_null: bool = False,
+                a_null=0, b_null=0, cap: Optional[int] = None) -> DistSpMat:
+    """General union/intersection EWise on aligned grids
+    (≅ EWiseApply, ParFriends.h:2194-2243)."""
+    _check_same_grid(a, b)
+    ocap = cap if cap is not None else (
+        a.cap + b.cap if (allow_a_null or allow_b_null)
+        else max(a.cap, b.cap))
+    pr, pc = a.grid.pr, a.grid.pc
+
+    def one(ar, ac, av, an, br, bc, bv, bn):
+        at = tl.Tile(ar, ac, av, an, a.tile_m, a.tile_n)
+        bt = tl.Tile(br, bc, bv, bn, b.tile_m, b.tile_n)
+        return ta.ewise_apply(at, bt, fn, allow_a_null=allow_a_null,
+                              allow_b_null=allow_b_null, a_null=a_null,
+                              b_null=b_null, cap=ocap)
+
+    out = jax.vmap(one)(
+        a.rows.reshape(pr * pc, -1), a.cols.reshape(pr * pc, -1),
+        a.vals.reshape(pr * pc, -1), a.nnz.reshape(-1),
+        b.rows.reshape(pr * pc, -1), b.cols.reshape(pr * pc, -1),
+        b.vals.reshape(pr * pc, -1), b.nnz.reshape(-1))
+    return _rewrap(a, out)
+
+
+# ---------------------------------------------------------------------------
+# Loops (≅ AddLoops, SpParMat.h:154)
+# ---------------------------------------------------------------------------
+
+def add_loops(a: DistSpMat, loop_val, replace_existing: bool = False) -> DistSpMat:
+    """Ensure every diagonal entry exists with value ``loop_val``
+    (replace_existing=True overwrites existing diagonal values; False
+    keeps them, adding only missing loops — the reference's AddLoops
+    semantics). Requires nrows == ncols."""
+    if a.nrows != a.ncols:
+        raise ValueError("add_loops needs a square matrix")
+    pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
+    ocap = cap + a.tile_m
+
+    ti = jnp.repeat(jnp.arange(pr, dtype=jnp.int32), pc)
+    tj = jnp.tile(jnp.arange(pc, dtype=jnp.int32), pr)
+
+    def one(rows, cols, vals, nnz, i, j):
+        t = tl.Tile(rows, cols, vals, nnz, a.tile_m, a.tile_n)
+        # global diag positions crossing this tile: g = i*tile_m + r =
+        # j*tile_n + c with 0<=r<tile_m, 0<=c<tile_n, g < nrows
+        r = jnp.arange(a.tile_m, dtype=jnp.int32)
+        g = i * a.tile_m + r
+        c = g - j * a.tile_n
+        on_tile = (c >= 0) & (c < a.tile_n) & (g < a.nrows)
+        diag = tl.from_coo(
+            tl.SATADD, jnp.where(on_tile, r, a.tile_m),
+            jnp.where(on_tile, c, a.tile_n),
+            jnp.full((a.tile_m,), jnp.asarray(loop_val, a.dtype)),
+            nrows=a.tile_m, ncols=a.tile_n, cap=a.tile_m,
+            valid=on_tile, dedup=False)
+        def merge(va, vb, a_has, b_has):
+            take_b = jnp.logical_and(
+                b_has, jnp.logical_or(replace_existing,
+                                      jnp.logical_not(a_has)))
+            return jnp.where(take_b, vb, va)
+
+        return ta.ewise_apply(t, diag, merge, allow_a_null=True,
+                              allow_b_null=True, cap=ocap,
+                              pass_presence=True)
+
+    out = jax.vmap(one)(
+        a.rows.reshape(-1, cap), a.cols.reshape(-1, cap),
+        a.vals.reshape(-1, cap), a.nnz.reshape(-1), ti, tj)
+    return _rewrap(a, out)

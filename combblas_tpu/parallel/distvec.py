@@ -175,3 +175,170 @@ def sp_realign(v: DistSpVec, axis: str, block: Optional[int] = None,
     am = realign(DistVec(v.active, v.grid, v.axis, v.glen), axis, block,
                  False)
     return DistSpVec(dv.data, am.data, v.grid, axis, v.glen)
+
+
+# ---------------------------------------------------------------------------
+# Vector primitives (≅ FullyDistVec.cpp:393-513, FullyDistSpVec.cpp:
+# 511,712,890,1800). Vectors are O(n) dense arrays — tiny next to the
+# matrix — so value-routing primitives (Invert, Uniq, sort) operate on
+# the logical global view and let XLA lower the resharding; this is the
+# same data volume the reference moves through its AlltoAll, without
+# the index-list bookkeeping.
+# ---------------------------------------------------------------------------
+
+def _flat(v) -> Array:
+    """Logical global view (glen,) of a DistVec/DistSpVec data array."""
+    return v.data.reshape(-1)[:v.glen]
+
+
+def _from_flat(template, flat: Array, fill=0):
+    nb, block = template.data.shape
+    pad = nb * block - flat.shape[0]
+    data = jnp.pad(flat, (0, pad), constant_values=fill).reshape(nb, block)
+    data = jax.lax.with_sharding_constraint(
+        data, template.grid.sharding(template.axis, None))
+    return data
+
+
+def ewise_apply(u: DistVec, v: DistVec, fn) -> DistVec:
+    """Dense-dense binary EWiseApply (≅ FullyDistVec.h:204)."""
+    if (u.axis, u.glen, u.block) != (v.axis, v.glen, v.block):
+        raise ValueError("ewise_apply needs identically aligned vectors")
+    return dataclasses.replace(u, data=fn(u.data, v.data))
+
+
+def sp_ewise_apply(su: DistSpVec, v: DistVec, fn,
+                   only_active: bool = True) -> DistSpVec:
+    """Sparse-dense EWiseApply (≅ ParFriends.h:2479): out value =
+    fn(su, v) where su is active; inactive positions keep su's data
+    (and stay inactive) when only_active, else become active too."""
+    if (su.axis, su.glen, su.data.shape) != (v.axis, v.glen, v.data.shape):
+        raise ValueError("sp_ewise_apply needs aligned vectors")
+    out = fn(su.data, v.data)
+    if only_active:
+        data = jnp.where(su.active, out, su.data)
+        return dataclasses.replace(su, data=data)
+    return dataclasses.replace(su, data=out,
+                               active=jnp.ones_like(su.active))
+
+
+def sp_sp_ewise_apply(su: DistSpVec, sv: DistSpVec, fn, *,
+                      union: bool = False, u_null=0, v_null=0) -> DistSpVec:
+    """Sparse-sparse EWiseApply (≅ ParFriends.h:2592): intersection by
+    default; union=True treats a missing side as its null value."""
+    if (su.axis, su.glen, su.data.shape) != (sv.axis, sv.glen,
+                                             sv.data.shape):
+        raise ValueError("sp_sp_ewise_apply needs aligned vectors")
+    un = jnp.asarray(u_null, su.data.dtype)
+    vn = jnp.asarray(v_null, sv.data.dtype)
+    a = jnp.where(su.active, su.data, un)
+    b = jnp.where(sv.active, sv.data, vn)
+    out = fn(a, b)
+    active = (su.active | sv.active) if union else (su.active & sv.active)
+    return DistSpVec(jnp.where(active, out, su.data), active,
+                     su.grid, su.axis, su.glen)
+
+
+def set_element(v: DistVec, idx, value) -> DistVec:
+    """v[idx] <- value (≅ SetElement, FullyDistVec.cpp:513)."""
+    idx = jnp.asarray(idx, jnp.int32)
+    data = v.data.at[idx // v.block, idx % v.block].set(
+        jnp.asarray(value, v.dtype))
+    return dataclasses.replace(v, data=data)
+
+
+def get_element(v: DistVec, idx) -> Array:
+    """v[idx] (≅ GetElement)."""
+    idx = jnp.asarray(idx, jnp.int32)
+    return v.data[idx // v.block, idx % v.block]
+
+
+def gather(v: DistVec, idx: DistVec) -> DistVec:
+    """out[i] = v[idx[i]] — vector composition (the body of the
+    reference's subscript-by-vector `operator(ri)`, FullyDistVec.h and
+    of pointer-jumping f[f] in the CC algorithms). ``idx`` values must
+    be in [0, v.glen); out is aligned like ``idx``."""
+    flat_v = _flat(v)
+    flat_i = jnp.clip(_flat(idx), 0, v.glen - 1)
+    out = flat_v[flat_i]
+    return DistVec(_from_flat(idx, out), idx.grid, idx.axis, idx.glen)
+
+
+def rand_perm(key, grid: ProcGrid, axis: str, glen: int,
+              block: Optional[int] = None) -> DistVec:
+    """Random permutation of 0..glen-1 (≅ RandPerm, FullyDistVec.cpp)."""
+    perm = jax.random.permutation(key, glen).astype(jnp.int32)
+    return from_global(grid, axis, perm, fill=0, block=block)
+
+
+def find_inds(v: DistVec, pred) -> DistSpVec:
+    """Positions where pred(value) holds, as a sparse vector whose
+    values are the global indices (≅ FindInds, FullyDistVec.cpp:393 —
+    static-shape form: the reference returns a packed index vector,
+    here the mask IS the result; `sp_compact` packs it on host)."""
+    act = pred(v.data) & v.valid_mask()
+    return DistSpVec(v.global_index(), act, v.grid, v.axis, v.glen)
+
+
+def sp_compact(sv: DistSpVec) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side packed (index, value) view of a sparse vector (the
+    dynamic-shape boundary: test/app-driver use only)."""
+    d, a = sv.to_global()
+    idx = np.nonzero(a)[0]
+    return idx, d[idx]
+
+
+def invert(sv: DistSpVec, out_glen: Optional[int] = None,
+           fill=-1) -> DistSpVec:
+    """Value<->index inversion: out[sv[i]] = i for active i
+    (≅ FullyDistSpVec::Invert, FullyDistSpVec.cpp:1800). Values must be
+    a permutation of distinct in-range targets (later duplicates win
+    nondeterministically otherwise, as in the reference's warning)."""
+    out_glen = sv.glen if out_glen is None else out_glen
+    vals = _flat(sv.dense)
+    act = _flat(DistVec(sv.active, sv.grid, sv.axis, sv.glen))
+    idx = jnp.arange(sv.glen, dtype=jnp.int32)
+    tgt = jnp.where(act, jnp.clip(vals.astype(jnp.int32), 0, out_glen), out_glen)
+    out = jnp.full((out_glen + 1,), fill, jnp.int32)
+    out = out.at[tgt].set(idx, mode="drop")[:out_glen]
+    oact = jnp.zeros((out_glen + 1,), bool).at[tgt].set(
+        True, mode="drop")[:out_glen]
+    tpl = DistVec(jnp.zeros((sv.data.shape[0],
+                             -(-out_glen // sv.data.shape[0])), jnp.int32),
+                  sv.grid, sv.axis, out_glen)
+    return DistSpVec(_from_flat(tpl, out, fill),
+                     _from_flat(tpl, oact, False), sv.grid, sv.axis,
+                     out_glen)
+
+
+def uniq(sv: DistSpVec) -> DistSpVec:
+    """Keep the first (lowest-index) occurrence of every distinct
+    active value (≅ Uniq, FullyDistSpVec.cpp:890)."""
+    vals = _flat(sv.dense)
+    act = _flat(DistVec(sv.active, sv.grid, sv.axis, sv.glen))
+    n = sv.glen
+    idx = jnp.arange(n, dtype=jnp.int32)
+    # sort by (inactive-last, value, index); first of each value run wins
+    key_act = (~act).astype(jnp.int32)
+    order = jnp.lexsort((idx, vals, key_act))
+    sv_vals = vals[order]
+    sv_act = act[order]
+    first = jnp.concatenate([jnp.ones((1,), bool),
+                             sv_vals[1:] != sv_vals[:-1]]) & sv_act
+    # route the keep flag back to original positions
+    keep = jnp.zeros((n,), bool).at[order].set(first)
+    return dataclasses.replace(
+        sv, active=_from_flat(sv, keep & act, False))
+
+
+def sp_sort(sv: DistSpVec):
+    """Ascending sort of the active values (≅ FullyDistSpVec::sort,
+    FullyDistSpVec.cpp:712). Returns (sorted_vals, perm_index) as
+    flat (glen,) arrays with the live prefix of length nnz: perm[k] is
+    the original global index of the k-th smallest value."""
+    vals = _flat(sv.dense)
+    act = _flat(DistVec(sv.active, sv.grid, sv.axis, sv.glen))
+    idx = jnp.arange(sv.glen, dtype=jnp.int32)
+    key_act = (~act).astype(jnp.int32)
+    order = jnp.lexsort((idx, vals, key_act))
+    return vals[order], idx[order]

@@ -1,0 +1,171 @@
+"""Distributed matrix algebra (parallel.algebra) golden tests on the
+8-device CPU mesh, against dense numpy."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import algebra as alg
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()          # 2x4 over the 8 virtual devices
+
+
+def _dist(rng, grid, nrows=37, ncols=29, density=0.25):
+    dense = rng.random((nrows, ncols), dtype=np.float32)
+    dense = np.where(rng.random((nrows, ncols)) < density, dense,
+                     np.float32(0))
+    a = dm.from_dense(S.PLUS, grid, dense, 0.0)
+    return a, dense
+
+
+def _square(fn, v):
+    return fn(v)
+
+
+class TestReduce:
+    def test_row_sum(self, rng, grid):
+        a, d = _dist(rng, grid)
+        got = alg.reduce(S.PLUS, a, "row")
+        assert got.axis == ROW_AXIS and got.glen == d.shape[0]
+        np.testing.assert_allclose(got.to_global(), d.sum(1), rtol=1e-5)
+
+    def test_col_sum(self, rng, grid):
+        a, d = _dist(rng, grid)
+        got = alg.reduce(S.PLUS, a, "col")
+        assert got.axis == COL_AXIS and got.glen == d.shape[1]
+        np.testing.assert_allclose(got.to_global(), d.sum(0), rtol=1e-5)
+
+    def test_col_max_mapped(self, rng, grid):
+        a, d = _dist(rng, grid)
+        got = alg.reduce(S.MAX, a, "col", map_val=jnp.square)
+        exp = np.where((d != 0).any(0), (d * d).max(0, initial=-np.inf),
+                       -np.inf)
+        np.testing.assert_allclose(got.to_global(), exp, rtol=1e-5)
+
+
+class TestApplyPrune:
+    def test_apply(self, rng, grid):
+        a, d = _dist(rng, grid)
+        got = dm.to_dense(alg.apply(a, jnp.square), 0.0)
+        np.testing.assert_allclose(got, d * d, rtol=1e-5)
+
+    def test_prune(self, rng, grid):
+        a, d = _dist(rng, grid)
+        got = alg.prune(a, _half_pred)
+        np.testing.assert_allclose(dm.to_dense(got, 0.0),
+                                   np.where(d > 0.5, 0, d), rtol=1e-5)
+
+    def test_remove_loops(self, rng, grid):
+        a, d = _dist(rng, grid, nrows=31, ncols=31)
+        got = dm.to_dense(alg.remove_loops(a), 0.0)
+        exp = d.copy()
+        np.fill_diagonal(exp, 0)
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    def test_add_loops(self, rng, grid):
+        a, d = _dist(rng, grid, nrows=31, ncols=31)
+        got = dm.to_dense(alg.add_loops(a, 7.0), 0.0)
+        exp = d.copy()
+        dd = np.diagonal(exp).copy()
+        np.fill_diagonal(exp, np.where(dd == 0, 7.0, dd))
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+        # replace_existing overwrites
+        got2 = dm.to_dense(alg.add_loops(a, 7.0, replace_existing=True), 0.0)
+        exp2 = d.copy()
+        np.fill_diagonal(exp2, 7.0)
+        np.testing.assert_allclose(got2, exp2, rtol=1e-5)
+
+    def test_prune_column(self, rng, grid):
+        a, d = _dist(rng, grid)
+        thr_np = rng.random(d.shape[1], dtype=np.float32)
+        thr = dv.from_global(grid, COL_AXIS, jnp.asarray(thr_np),
+                             block=a.tile_n)
+        got = dm.to_dense(alg.prune_column(a, thr, _lt_pred), 0.0)
+        exp = np.where(d < thr_np[None, :], 0, d) * (d != 0)
+        np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    def test_dim_apply_col(self, rng, grid):
+        a, d = _dist(rng, grid)
+        sc_np = rng.random(d.shape[1], dtype=np.float32) + 0.5
+        sc = dv.from_global(grid, COL_AXIS, jnp.asarray(sc_np),
+                            block=a.tile_n)
+        got = dm.to_dense(alg.dim_apply(a, "col", sc, _mul2), 0.0)
+        np.testing.assert_allclose(got, d * sc_np[None, :] * (d != 0),
+                                   rtol=1e-5)
+
+    def test_make_col_stochastic_pattern(self, rng, grid):
+        """Reduce(col) + DimApply = MakeColStochastic (MCL.cpp:390)."""
+        a, d = _dist(rng, grid, density=0.5)
+        sums = alg.reduce(S.PLUS, a, "col")
+        inv = sums.map(_safemultinv)
+        got = dm.to_dense(alg.dim_apply(a, "col", inv, _mul2), 0.0)
+        colsum = got.sum(0)
+        nonempty = (d != 0).any(0)
+        np.testing.assert_allclose(colsum[nonempty], 1.0, rtol=1e-4)
+
+
+class TestKselect:
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_kselect1(self, rng, grid, k):
+        a, d = _dist(rng, grid, density=0.4)
+        got = alg.kselect1(a, k, fill=-1.0).to_global()
+        for j in range(d.shape[1]):
+            cv = d[:, j][d[:, j] != 0]
+            exp = np.sort(cv)[-k] if len(cv) >= k else -1.0
+            assert got[j] == pytest.approx(exp), f"col {j}"
+
+    def test_global_topk_prune(self, rng, grid):
+        a, d = _dist(rng, grid, density=0.6)
+        k = 4
+        thr = alg.kselect1(a, k, fill=0.0)
+        got = dm.to_dense(alg.prune_column(a, thr, _lt_pred), 0.0)
+        percol = (got != 0).sum(0)
+        np.testing.assert_array_equal(percol,
+                                      np.minimum((d != 0).sum(0), k))
+
+
+class TestEWise:
+    def test_mult(self, rng, grid):
+        a, da = _dist(rng, grid)
+        b, db = _dist(rng, grid)
+        got = dm.to_dense(alg.ewise_mult(jnp.multiply, a, b), 0.0)
+        np.testing.assert_allclose(got, da * db, rtol=1e-5)
+
+    def test_exclude(self, rng, grid):
+        a, da = _dist(rng, grid)
+        b, db = _dist(rng, grid)
+        got = dm.to_dense(alg.set_difference(a, b), 0.0)
+        np.testing.assert_allclose(got, np.where(db != 0, 0, da), rtol=1e-5)
+
+    def test_apply_union(self, rng, grid):
+        a, da = _dist(rng, grid)
+        b, db = _dist(rng, grid)
+        got = alg.ewise_apply(a, b, jnp.add, allow_a_null=True,
+                              allow_b_null=True)
+        np.testing.assert_allclose(dm.to_dense(got, 0.0), da + db,
+                                   rtol=1e-5)
+        assert got.getnnz() == int(((da != 0) | (db != 0)).sum())
+
+
+# module-level fns: static jit keys must be stable across calls
+def _half_pred(v):
+    return v > 0.5
+
+
+def _lt_pred(v, s):
+    return v < s
+
+
+def _mul2(v, s):
+    return v * s
+
+
+def _safemultinv(v):
+    return jnp.where(v != 0, 1.0 / v, 0.0)

@@ -1,0 +1,80 @@
+"""FastSV connected components vs scipy.sparse.csgraph golden labels
+on the 8-device mesh (≅ FastSV.cpp driver semantics)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csg
+
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.models import cc
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()
+
+
+def _dist_from_edges(grid, r, c, n):
+    return dm.from_global_coo(S.LOR, grid, r, c,
+                              jnp.ones_like(r, jnp.bool_), n, n)
+
+
+def _scipy_labels(r, c, n):
+    g = sp.coo_matrix((np.ones(len(r)), (np.asarray(r), np.asarray(c))),
+                      shape=(n, n))
+    return csg.connected_components(g, directed=False)
+
+
+def _assert_same_partition(got, exp_ncomp, exp_labels):
+    # identical partitions up to label naming
+    ncomp = len(np.unique(got))
+    assert ncomp == exp_ncomp
+    # map each expected component to one got-label; must be a bijection
+    mapping = {}
+    for gl, el in zip(got, exp_labels):
+        assert mapping.setdefault(el, gl) == gl
+
+
+def test_two_triangles(grid):
+    # 0-1-2 triangle, 3-4-5 path, 6 isolated
+    r = np.array([0, 1, 2, 3, 4], np.int32)
+    c = np.array([1, 2, 0, 4, 5], np.int32)
+    rs, cs = np.concatenate([r, c]), np.concatenate([c, r])
+    a = _dist_from_edges(grid, rs, cs, 7)
+    labels, ncomp = cc.connected_components(a)
+    got = labels.to_global()
+    assert ncomp == 3
+    assert got[0] == got[1] == got[2]
+    assert got[3] == got[4] == got[5]
+    assert got[6] not in (got[0], got[3])
+
+
+def test_roots_are_min_ids(grid):
+    r = np.array([5, 9, 2], np.int32)
+    c = np.array([9, 5, 7], np.int32)
+    rs, cs = np.concatenate([r, c]), np.concatenate([c, r])
+    a = _dist_from_edges(grid, rs, cs, 12)
+    f = cc.fastsv(a).to_global()
+    assert f[5] == f[9] == 5
+    assert f[2] == f[7] == 2
+    # isolated vertices are their own roots
+    for v in (0, 1, 3, 4, 6, 8, 10, 11):
+        assert f[v] == v
+
+
+def test_rmat_vs_scipy(grid):
+    for scale, ef in [(8, 4), (10, 2), (11, 8)]:
+        n = 1 << scale
+        r, c = generate.rmat_edges(jax.random.key(scale), scale, ef)
+        r, c = generate.symmetrize(r, c)
+        a = _dist_from_edges(grid, r, c, n)
+        labels, ncomp = cc.connected_components(a)
+        exp_ncomp, exp_labels = _scipy_labels(r, c, n)
+        assert ncomp == exp_ncomp, f"scale {scale}"
+        _assert_same_partition(labels.to_global(), exp_ncomp, exp_labels)

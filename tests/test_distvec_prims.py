@@ -1,0 +1,158 @@
+"""Distributed-vector primitive golden tests vs numpy on the 8-device
+mesh (≅ FullyDistVec.cpp / FullyDistSpVec.cpp behaviors)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()
+
+
+def _vec(rng, grid, glen=53, axis=ROW_AXIS, ints=False):
+    if ints:
+        vals = rng.integers(0, 100, glen).astype(np.int32)
+    else:
+        vals = rng.random(glen, dtype=np.float32)
+    return dv.from_global(grid, axis, jnp.asarray(vals)), vals
+
+
+def _spvec(rng, grid, glen=53, axis=ROW_AXIS, density=0.4, ints=False):
+    v, vals = _vec(rng, grid, glen, axis, ints)
+    act = rng.random(glen) < density
+    actv = dv.from_global(grid, axis, jnp.asarray(act), fill=False)
+    return dv.DistSpVec(v.data, actv.data, grid, axis, glen), vals, act
+
+
+class TestDenseOps:
+    def test_ewise_apply(self, rng, grid):
+        u, du = _vec(rng, grid)
+        v, dVals = _vec(rng, grid)
+        got = dv.ewise_apply(u, v, jnp.add)
+        np.testing.assert_allclose(got.to_global(), du + dVals, rtol=1e-6)
+
+    def test_set_get_element(self, rng, grid):
+        v, d = _vec(rng, grid)
+        v2 = dv.set_element(v, 17, 3.5)
+        assert float(dv.get_element(v2, 17)) == 3.5
+        assert float(dv.get_element(v2, 16)) == pytest.approx(d[16])
+
+    def test_gather_compose(self, rng, grid):
+        v, d = _vec(rng, grid, ints=True)
+        idx_np = rng.integers(0, 53, 53).astype(np.int32)
+        idx = dv.from_global(grid, ROW_AXIS, jnp.asarray(idx_np))
+        got = dv.gather(v, idx)
+        np.testing.assert_array_equal(got.to_global(), d[idx_np])
+
+    def test_gather_cross_axis(self, rng, grid):
+        v, d = _vec(rng, grid, ints=True)
+        idx_np = rng.integers(0, 53, 31).astype(np.int32)
+        idx = dv.from_global(grid, COL_AXIS, jnp.asarray(idx_np))
+        got = dv.gather(v, idx)
+        assert got.axis == COL_AXIS
+        np.testing.assert_array_equal(got.to_global(), d[idx_np])
+
+    def test_rand_perm(self, grid):
+        p = dv.rand_perm(jax.random.key(0), grid, ROW_AXIS, 40)
+        pg = p.to_global()
+        np.testing.assert_array_equal(np.sort(pg), np.arange(40))
+
+
+class TestSparseOps:
+    def test_find_inds(self, rng, grid):
+        v, d = _vec(rng, grid)
+        got = dv.find_inds(v, _gt_half)
+        idx, vals = dv.sp_compact(got)
+        np.testing.assert_array_equal(idx, np.nonzero(d > 0.5)[0])
+        np.testing.assert_array_equal(vals, idx)  # values ARE the indices
+
+    def test_sp_ewise_apply(self, rng, grid):
+        su, d, act = _spvec(rng, grid)
+        w, dw = _vec(rng, grid)
+        got = dv.sp_ewise_apply(su, w, jnp.add)
+        gd, ga = got.to_global()
+        np.testing.assert_array_equal(ga, act)
+        np.testing.assert_allclose(gd[act], (d + dw)[act], rtol=1e-6)
+        np.testing.assert_allclose(gd[~act], d[~act], rtol=1e-6)
+
+    def test_sp_sp_intersection_union(self, rng, grid):
+        su, duv, ua = _spvec(rng, grid)
+        sv, dvv, va = _spvec(rng, grid)
+        inter = dv.sp_sp_ewise_apply(su, sv, jnp.add)
+        gd, ga = inter.to_global()
+        np.testing.assert_array_equal(ga, ua & va)
+        np.testing.assert_allclose(gd[ga], (duv + dvv)[ga], rtol=1e-6)
+        uni = dv.sp_sp_ewise_apply(su, sv, jnp.add, union=True)
+        gd2, ga2 = uni.to_global()
+        np.testing.assert_array_equal(ga2, ua | va)
+        exp = np.where(ua, duv, 0) + np.where(va, dvv, 0)
+        np.testing.assert_allclose(gd2[ga2], exp[ga2], rtol=1e-6)
+
+    def test_invert_permutation(self, rng, grid):
+        n = 41
+        perm = rng.permutation(n).astype(np.int32)
+        v = dv.from_global(grid, ROW_AXIS, jnp.asarray(perm))
+        sv = dv.sp_from_dense_mask(v, jnp.ones_like(v.data, bool))
+        got = dv.invert(sv)
+        gd, ga = got.to_global()
+        assert ga.all()
+        inv = np.empty(n, np.int32)
+        inv[perm] = np.arange(n)
+        np.testing.assert_array_equal(gd, inv)
+
+    def test_invert_partial(self, rng, grid):
+        # sparse subset: only active entries scatter
+        n = 30
+        v = dv.iota(grid, ROW_AXIS, n)
+        act = np.zeros(n, bool)
+        act[[3, 7, 20]] = True
+        vals = np.zeros(n, np.int32)
+        vals[[3, 7, 20]] = [10, 0, 29]
+        sv = dv.DistSpVec(
+            dv.from_global(grid, ROW_AXIS, jnp.asarray(vals)).data,
+            dv.from_global(grid, ROW_AXIS, jnp.asarray(act),
+                           fill=False).data,
+            grid, ROW_AXIS, n)
+        got = dv.invert(sv)
+        gd, ga = got.to_global()
+        np.testing.assert_array_equal(np.nonzero(ga)[0], [0, 10, 29])
+        assert gd[10] == 3 and gd[0] == 7 and gd[29] == 20
+
+    def test_uniq(self, rng, grid):
+        n = 40
+        vals = np.array([rng.integers(0, 8) for _ in range(n)], np.int32)
+        act = rng.random(n) < 0.7
+        sv = dv.DistSpVec(
+            dv.from_global(grid, ROW_AXIS, jnp.asarray(vals)).data,
+            dv.from_global(grid, ROW_AXIS, jnp.asarray(act),
+                           fill=False).data,
+            grid, ROW_AXIS, n)
+        got = dv.uniq(sv)
+        gd, ga = got.to_global()
+        # kept = first occurrence of each active value
+        seen = {}
+        for i in range(n):
+            if act[i] and vals[i] not in seen:
+                seen[vals[i]] = i
+        exp = np.zeros(n, bool)
+        exp[list(seen.values())] = True
+        np.testing.assert_array_equal(ga, exp)
+
+    def test_sp_sort(self, rng, grid):
+        sv, vals, act = _spvec(rng, grid, ints=True)
+        sorted_vals, perm = dv.sp_sort(sv)
+        k = int(act.sum())
+        sv_np = np.sort(vals[act])
+        np.testing.assert_array_equal(np.asarray(sorted_vals)[:k], sv_np)
+        # perm routes back to original values
+        np.testing.assert_array_equal(vals[np.asarray(perm)[:k]], sv_np)
+
+
+def _gt_half(x):
+    return x > 0.5

@@ -1,0 +1,223 @@
+"""Golden-model tests for the tile-level algebra surface
+(reduce/apply/prune/kselect/dim_apply/EWise/col slice-concat) against
+dense numpy (the MultTest golden-file pattern, ReleaseTests/)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops import tile_algebra as ta
+
+
+def _rand_tile(rng, nrows=13, ncols=11, density=0.3, cap=None, ints=False):
+    dense = rng.random((nrows, ncols), dtype=np.float32)
+    mask = rng.random((nrows, ncols)) < density
+    dense = np.where(mask, dense, np.float32(0.0))
+    if ints:
+        dense = np.rint(dense * 100).astype(np.int32)
+    cap = cap or max(64, int(mask.sum()) + 8)
+    t = tl.from_dense(jnp.asarray(dense), jnp.asarray(0, dense.dtype), cap)
+    return t, dense
+
+
+def _tile_to_dense(t, zero=0.0):
+    return np.asarray(tl.to_dense(t, jnp.asarray(zero, t.dtype)))
+
+
+class TestReduce:
+    def test_reduce_rows_sum(self, rng):
+        t, d = _rand_tile(rng)
+        got = np.asarray(ta.reduce(S.PLUS, t, "row"))
+        np.testing.assert_allclose(got, d.sum(1), rtol=1e-6)
+
+    def test_reduce_cols_sum(self, rng):
+        t, d = _rand_tile(rng)
+        got = np.asarray(ta.reduce(S.PLUS, t, "col"))
+        np.testing.assert_allclose(got, d.sum(0), rtol=1e-6)
+
+    def test_reduce_cols_max_with_map(self, rng):
+        t, d = _rand_tile(rng)
+        got = np.asarray(ta.reduce(S.MAX, t, "col", map_val=lambda v: v * v))
+        exp = np.where((d != 0).any(0), (d * d).max(0, initial=-np.inf), -np.inf)
+        np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+    def test_empty_rows_get_identity(self, rng):
+        t, d = _rand_tile(rng, density=0.05)
+        got = np.asarray(ta.reduce(S.MIN, t, "row"))
+        empty = ~(d != 0).any(1)
+        assert np.isposinf(got[empty]).all()
+
+    def test_nnz_counts(self, rng):
+        t, d = _rand_tile(rng)
+        np.testing.assert_array_equal(np.asarray(ta.nnz_per_row(t)),
+                                      (d != 0).sum(1))
+        np.testing.assert_array_equal(np.asarray(ta.nnz_per_column(t)),
+                                      (d != 0).sum(0))
+
+
+class TestApplyPrune:
+    def test_apply(self, rng):
+        t, d = _rand_tile(rng)
+        got = _tile_to_dense(ta.apply(t, lambda v: v * 2 + 1))
+        exp = np.where(d != 0, d * 2 + 1, 0.0)
+        np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+    def test_prune(self, rng):
+        t, d = _rand_tile(rng)
+        got = ta.prune(t, lambda v: v > 0.5)
+        exp = np.where(d > 0.5, 0.0, d)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+        assert int(got.nnz) == int((exp != 0).sum())
+
+    def test_prune_keeps_sorted(self, rng):
+        t, _ = _rand_tile(rng)
+        got = ta.prune(t, lambda v: v > 0.5)
+        k = int(got.nnz)
+        r, c = np.asarray(got.rows)[:k], np.asarray(got.cols)[:k]
+        keys = r.astype(np.int64) * (got.ncols + 1) + c
+        assert (np.diff(keys) > 0).all()
+
+    def test_prune_i_global_coords(self, rng):
+        t, d = _rand_tile(rng)
+        # remove the (global) diagonal of a tile placed at offset (3, 3)
+        got = ta.prune_i(t, lambda i, j, v: i == j, row_offset=3,
+                         col_offset=3)
+        exp = d.copy()
+        np.fill_diagonal(exp, 0.0)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+
+    def test_prune_column(self, rng):
+        t, d = _rand_tile(rng)
+        thr = rng.random(d.shape[1])
+        got = ta.prune_column(t, jnp.asarray(thr), lambda v, s: v < s)
+        exp = np.where(d < thr[None, :], 0.0, d) * (d != 0)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+
+    def test_dim_apply_col_scale(self, rng):
+        t, d = _rand_tile(rng)
+        scale = rng.random(d.shape[1]) + 0.5
+        got = ta.dim_apply(t, "col", jnp.asarray(scale), lambda v, s: v * s)
+        np.testing.assert_allclose(_tile_to_dense(got),
+                                   d * scale[None, :] * (d != 0), rtol=1e-6)
+
+    def test_dim_apply_row_scale(self, rng):
+        t, d = _rand_tile(rng)
+        scale = rng.random(d.shape[0]) + 0.5
+        got = ta.dim_apply(t, "row", jnp.asarray(scale), lambda v, s: v * s)
+        np.testing.assert_allclose(_tile_to_dense(got),
+                                   d * scale[:, None] * (d != 0), rtol=1e-6)
+
+
+class TestKselect:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_kth_largest_per_column(self, rng, k):
+        t, d = _rand_tile(rng, density=0.5)
+        got = np.asarray(ta.kselect_col(t, k, fill=-1.0))
+        for j in range(d.shape[1]):
+            colvals = d[:, j][d[:, j] != 0]
+            if len(colvals) >= k:
+                assert got[j] == pytest.approx(np.sort(colvals)[-k])
+            else:
+                assert got[j] == -1.0
+
+    def test_kselect_int_exact(self, rng):
+        t, d = _rand_tile(rng, ints=True, density=0.6)
+        got = np.asarray(ta.kselect_col(t, 2, fill=-7))
+        for j in range(d.shape[1]):
+            colvals = d[:, j][d[:, j] != 0]
+            exp = np.sort(colvals)[-2] if len(colvals) >= 2 else -7
+            assert got[j] == exp
+
+    def test_topk_prune_roundtrip(self, rng):
+        """kselect + prune_column keeps each column's top-k (the MCL
+        select pattern, MCLPruneRecoverySelect ParFriends.h:186)."""
+        t, d = _rand_tile(rng, density=0.7)
+        k = 3
+        thr = ta.kselect_col(t, k, fill=0.0)
+        got = ta.prune_column(t, thr, lambda v, s: v < s)
+        gd = _tile_to_dense(got)
+        percol = (gd != 0).sum(0)
+        full = (d != 0).sum(0)
+        assert (percol == np.minimum(full, k)).all()
+        # kept entries are exactly the largest ones
+        for j in range(d.shape[1]):
+            kept = gd[:, j][gd[:, j] != 0]
+            exp = np.sort(d[:, j][d[:, j] != 0])[-k:]
+            np.testing.assert_allclose(np.sort(kept), exp[-len(kept):],
+                                       rtol=1e-6)
+
+
+class TestEWise:
+    def test_ewise_mult_intersection(self, rng):
+        a, da = _rand_tile(rng)
+        b, db = _rand_tile(rng)
+        got = ta.ewise_mult(jnp.multiply, a, b)
+        np.testing.assert_allclose(_tile_to_dense(got), da * db, rtol=1e-6)
+
+    def test_ewise_mult_exclude(self, rng):
+        a, da = _rand_tile(rng)
+        b, db = _rand_tile(rng)
+        got = ta.ewise_mult(jnp.multiply, a, b, exclude=True)
+        exp = np.where(db != 0, 0.0, da)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+
+    def test_set_difference(self, rng):
+        a, da = _rand_tile(rng)
+        b, db = _rand_tile(rng)
+        got = ta.set_difference(a, b)
+        exp = np.where(db != 0, 0.0, da)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+
+    def test_ewise_apply_union_add(self, rng):
+        a, da = _rand_tile(rng)
+        b, db = _rand_tile(rng)
+        got = ta.ewise_apply(a, b, jnp.add, allow_a_null=True,
+                             allow_b_null=True)
+        np.testing.assert_allclose(_tile_to_dense(got), da + db, rtol=1e-6)
+        assert int(got.nnz) == int(((da != 0) | (db != 0)).sum())
+
+    def test_ewise_apply_intersection_only(self, rng):
+        a, da = _rand_tile(rng)
+        b, db = _rand_tile(rng)
+        got = ta.ewise_apply(a, b, jnp.add)
+        exp = np.where((da != 0) & (db != 0), da + db, 0.0)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+
+    def test_ewise_apply_a_only_kept(self, rng):
+        a, da = _rand_tile(rng)
+        b, db = _rand_tile(rng)
+        got = ta.ewise_apply(a, b, lambda x, y: x - y, allow_b_null=True,
+                             b_null=0.0)
+        exp = np.where(da != 0, da - db, 0.0)
+        np.testing.assert_allclose(_tile_to_dense(got), exp, rtol=1e-6)
+
+    def test_ewise_sorted_output(self, rng):
+        a, _ = _rand_tile(rng)
+        b, _ = _rand_tile(rng)
+        got = ta.ewise_apply(a, b, jnp.add, allow_a_null=True,
+                             allow_b_null=True)
+        k = int(got.nnz)
+        r, c = np.asarray(got.rows)[:k], np.asarray(got.cols)[:k]
+        keys = r.astype(np.int64) * (got.ncols + 1) + c
+        assert (np.diff(keys) > 0).all()
+
+
+class TestColSliceConcat:
+    def test_slice_concat_roundtrip(self, rng):
+        t, d = _rand_tile(rng, ncols=12)
+        parts = [ta.col_slice(t, lo, lo + 4, cap=t.cap)
+                 for lo in (0, 4, 8)]
+        for i, p in enumerate(parts):
+            np.testing.assert_allclose(_tile_to_dense(p),
+                                       d[:, 4 * i:4 * (i + 1)], rtol=1e-6)
+        back = ta.col_concat(parts, cap=t.cap)
+        assert back.ncols == 12
+        np.testing.assert_allclose(_tile_to_dense(back), d, rtol=1e-6)
+
+    def test_uneven_slice(self, rng):
+        t, d = _rand_tile(rng, ncols=11)
+        p = ta.col_slice(t, 7, 11, cap=t.cap)
+        assert p.ncols == 4
+        np.testing.assert_allclose(_tile_to_dense(p), d[:, 7:], rtol=1e-6)
