@@ -482,6 +482,45 @@ def spgemm_flops(a: Tile, b: Tile) -> int:
     return int(np.asarray(spgemm_flops_per_entry(a, b), dtype=np.int64).sum())
 
 
+def spgemm_ranged(sr: Semiring, a: Tile, b: Tile, *, a_lo: int, b_lo: int,
+                  length: int, flops_cap: int, out_cap: int,
+                  dedup: bool = True) -> Tile:
+    """c = A[:, a_lo:a_lo+length] ⊗ B[b_lo:b_lo+length, :] — the ESC
+    multiply restricted to an inner-dimension window, without
+    compacting either operand (entries outside the window are masked).
+
+    This is the local body of streaming SUMMA on arbitrary grids
+    (parallel.spgemm): a stage's inner interval spans [a_lo, a_lo+length)
+    of A's local columns and [b_lo, b_lo+length) of B's local rows.
+    Padding entries (row == nrows) sort past every window, so the
+    searchsorted row pointers need no validity fixup.
+    """
+    _SAT = 2**30 - 1
+    if flops_cap > _SAT:
+        raise ValueError(
+            f"flops_cap {flops_cap} > 2^30-1: expansion indices saturate — "
+            "bound the per-call flop budget by splitting the multiply into "
+            "phases (parallel.spgemm.spgemm_phased)")
+    targets = jnp.arange(length + 1, dtype=jnp.int32) + jnp.asarray(
+        b_lo, jnp.int32)
+    bptr = jnp.searchsorted(b.rows, targets, side="left").astype(jnp.int32)
+    p = a.cols - jnp.asarray(a_lo, jnp.int32)      # inner window position
+    in_range = a.valid() & (p >= 0) & (p < length)
+    pcl = jnp.clip(p, 0, length - 1)
+    per = jnp.where(in_range, bptr[pcl + 1] - bptr[pcl], 0)
+    e_of_slot, offs, total = expand_indices(per, flops_cap)
+    slots = jnp.arange(flops_cap, dtype=jnp.int32)
+    e = jnp.clip(e_of_slot, 0, a.cap - 1)
+    live = slots < total
+    t = slots - offs[e]
+    bidx = jnp.clip(bptr[jnp.clip(p[e], 0, length - 1)] + t, 0, b.cap - 1)
+    crow = a.rows[e]
+    ccol = b.cols[bidx]
+    cval = sr.multiply(a.vals[e], b.vals[bidx])
+    return from_coo(sr.add, crow, ccol, cval, nrows=a.nrows, ncols=b.ncols,
+                    cap=out_cap, valid=live, dedup=dedup)
+
+
 @partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup"))
 def spgemm(sr: Semiring, a: Tile, b: Tile, *, flops_cap: int, out_cap: int,
            dedup: bool = True) -> Tile:
@@ -497,7 +536,7 @@ def spgemm(sr: Semiring, a: Tile, b: Tile, *, flops_cap: int, out_cap: int,
         raise ValueError(
             f"flops_cap {flops_cap} > 2^30-1: expansion indices saturate — "
             "bound the per-call flop budget by splitting the multiply into "
-            "phases (see parallel.spgemm)")
+            "phases (parallel.spgemm.spgemm_phased)")
     bptr = row_starts(b)
     acol = jnp.clip(a.cols, 0, a.ncols - 1)
     per = jnp.where(a.valid(), bptr[acol + 1] - bptr[acol], 0)

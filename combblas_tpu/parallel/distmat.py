@@ -173,6 +173,23 @@ def from_dense(add: Monoid, grid: ProcGrid, dense, zero,
                            nrows, ncols, cap=cap)
 
 
+def to_global_coo(a: DistSpMat) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side (rows, cols, vals) in global coordinates (the
+    gather-side of SparseCommon; feeds I/O writers and grid rebuilds)."""
+    rows = np.asarray(a.rows)
+    cols = np.asarray(a.cols)
+    vals = np.asarray(a.vals)
+    nnz = np.asarray(a.nnz)
+    rr, cc, vv = [], [], []
+    for i in range(a.grid.pr):
+        for j in range(a.grid.pc):
+            k = nnz[i, j]
+            rr.append(i * a.tile_m + rows[i, j, :k])
+            cc.append(j * a.tile_n + cols[i, j, :k])
+            vv.append(vals[i, j, :k])
+    return (np.concatenate(rr), np.concatenate(cc), np.concatenate(vv))
+
+
 def to_dense(a: DistSpMat, zero) -> np.ndarray:
     """Gather to a host dense array (test/debug only)."""
     out = np.full((a.grid.pr * a.tile_m, a.grid.pc * a.tile_n),
@@ -193,17 +210,26 @@ def to_dense(a: DistSpMat, zero) -> np.ndarray:
 # Structural ops
 # ---------------------------------------------------------------------------
 
-@jax.jit
 def transpose(a: DistSpMat) -> DistSpMat:
-    """A^T: grid-level block swap + local tile transpose
-    (≅ SpParMat::Transpose pairwise exchange, SpParMat.cpp:3470 —
-    here the exchange is an array axis swap XLA lowers to ppermute).
+    """A^T on any grid (≅ SpParMat::Transpose, SpParMat.cpp:3470).
 
-    Requires a square grid (as does the reference's complement-rank
-    exchange for vectors-of-tiles; non-square transposes go through a
-    global rebuild)."""
-    if not a.grid.square:
-        raise ValueError("transpose requires a square grid")
+    Square grids take the fast jitted path: grid-level block swap (an
+    array axis swap XLA lowers to the pairwise device exchange the
+    reference does by Sendrecv) + local tile transpose. Non-square
+    grids fall back to a host-side global rebuild — tile shapes change
+    (tile_m'=ceil(ncols/pr)), so entries genuinely reshuffle across all
+    devices; the reference sidesteps this by only ever building square
+    grids."""
+    if a.grid.square:
+        return _transpose_square(a)
+    r, c, v = to_global_coo(a)
+    from combblas_tpu.ops.semiring import PLUS
+    return from_global_coo(PLUS, a.grid, c, r, jnp.asarray(v),
+                           a.ncols, a.nrows, cap=a.cap, dedup=False)
+
+
+@jax.jit
+def _transpose_square(a: DistSpMat) -> DistSpMat:
     pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
     batched = tl.Tile(a.rows.reshape(-1, cap), a.cols.reshape(-1, cap),
                       a.vals.reshape(-1, cap), a.nnz.reshape(-1),

@@ -81,12 +81,14 @@ class ProcGrid:
 
     # -- SUMMA compatibility (≅ ProductGrid, src/CommGrid.cpp:164) ---------
     def stages_with(self, other: "ProcGrid") -> int:
+        """Stage count hint for a square-grid SUMMA. Non-square grids
+        are supported by the streaming SUMMA (parallel.spgemm), whose
+        stage structure comes from `_summa_intervals` instead (at most
+        pr + pc - 1 stages)."""
         if self.mesh.devices.shape != other.mesh.devices.shape or \
            (self.mesh.devices != other.mesh.devices).any():
             raise ValueError("GRIDMISMATCH: operands on different grids")
-        if not self.square:
-            raise ValueError("SUMMA requires a square grid (pr == pc)")
-        return self.pc
+        return max(self.pr, self.pc)
 
     def __hash__(self):
         return hash((self.mesh.devices.shape,

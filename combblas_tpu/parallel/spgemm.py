@@ -1,22 +1,46 @@
-"""Distributed SpGEMM: sparse SUMMA over the grid.
+"""Distributed SpGEMM: streaming sparse SUMMA + phased memory-bounded
+variants over the grid.
 
-Capability parity: `Mult_AnXBn_Synch` (ParFriends.h:1005) — √p stages
-of row/col matrix broadcast + local SpGEMM + final k-way merge — and
-its planning pass `EstimateFLOP` (ParFriends.h:356).
+Capability parity: `Mult_AnXBn_Synch` (ParFriends.h:1005 — per-stage
+matrix broadcast + local SpGEMM + k-way merge), its planning pass
+`EstimateFLOP` (ParFriends.h:356), the memory-constrained phased
+`MemEfficientSpGEMM` (ParFriends.h:450-733) with per-phase
+`MCLPruneRecoverySelect` (:186), and the block-streaming driver
+`BlockSpGEMM` (BlockSpGEMM.h:50-75).
 
-TPU-native re-design: the per-stage `BCastMatrix` pair becomes one
-`all_gather` of the local tile along each of the two mesh axes (XLA
-schedules the transfers; double-buffered/overlap variants of the
-reference are latency-hiding XLA already performs). The per-stage
-local multiply is the ESC kernel (ops.tile.spgemm) under a static
-per-stage FLOP budget, and the stage merge is one concat+sort+
-segment-reduce (≅ MultiwayMerge.h:412). `plan_spgemm` is the
-host-side shape oracle that replaces the symbolic estimator.
+TPU-native re-design:
+
+* **Streaming stages on any grid.** A SUMMA stage is an *interval* of
+  the inner dimension obtained by overlaying A's column tiling and B's
+  row tiling (≤ pr+pc-1 intervals; on square grids exactly √p — the
+  classic algorithm). Per stage, the one owning A tile and the one
+  owning B tile are broadcast along their mesh axis as a masked `psum`
+  (one contributor ⇒ sum = broadcast, the BCastMatrix of
+  SpParHelper.cpp:583 with O(cap) in-flight memory — NOT an up-front
+  all_gather of the whole block row/column), and the local multiply is
+  the window-masked ESC kernel (`tile.spgemm_ranged`) — no operand
+  compaction. Stage outputs fold into a fixed-capacity accumulator
+  (incremental 2-way `concat_merge`), keeping peak memory at
+  O(cap + flops_cap + out_cap) per device.
+
+* **Planning** (`plan_spgemm`) is one vectorized host pass (per-tile
+  row-count histogram + per-interval gather) — exact, like the
+  reference's EstimateFLOP, without the per-stage Python loops.
+
+* **Phasing** (`spgemm_phased`): B is split into per-tile local column
+  windows (≅ ColSplit, dcsc.h:101); each phase runs the streaming SUMMA
+  under its own flop budget and an optional between-phase prune hook
+  (MCL's select/recovery), then phases concatenate (`ColConcatenate`).
+  This removes any single-multiply flop ceiling: each phase's expansion
+  stays under 2^30 slots regardless of total FLOPs.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import math
 from functools import partial
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -25,76 +49,162 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops import tile_algebra as ta
 from combblas_tpu.ops.semiring import Semiring
 from combblas_tpu.parallel.distmat import DistSpMat
 from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
 
+_SAT = 2 ** 30 - 1
+
+
+def _check_product(a: DistSpMat, b: DistSpMat):
+    if a.grid != b.grid:
+        raise ValueError("GRIDMISMATCH: operands on different grids")
+    if a.ncols != b.nrows:
+        raise ValueError(f"DIMMISMATCH: A is {a.nrows}x{a.ncols}, "
+                         f"B is {b.nrows}x{b.ncols}")
+
+
+def _summa_intervals(a: DistSpMat, b: DistSpMat):
+    """Static stage list [(lo, hi, ja, la, ib, lb)]: the inner dim cut
+    at every A-column-tile and B-row-tile boundary. Each interval lies
+    inside exactly one A tile column (ja, local offset la) and one B
+    tile row (ib, local offset lb). ≅ ProductGrid's stage count
+    (src/CommGrid.cpp:164), generalized to non-square grids."""
+    inner = a.ncols
+    bounds = sorted({min(k * a.tile_n, inner) for k in range(a.grid.pc + 1)}
+                    | {min(k * b.tile_m, inner) for k in range(b.grid.pr + 1)})
+    out = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if hi > lo:
+            ja, ib = lo // a.tile_n, lo // b.tile_m
+            out.append((lo, hi, ja, lo - ja * a.tile_n, ib, lo - ib * b.tile_m))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Planning (≅ EstimateFLOP, ParFriends.h:356 — exact, vectorized)
+# ---------------------------------------------------------------------------
 
 def plan_spgemm(a: DistSpMat, b: DistSpMat) -> tuple[int, int]:
-    """Host-side shape oracle (≅ EstimateFLOP ParFriends.h:356 +
-    estimateNNZ): returns (stage_flops_cap, out_cap) — the max FLOPs
-    of any (i,j,k) stage-multiply, and a bound on any C tile's output
-    tuples (pre-dedup, capped by the dense tile size)."""
-    stages = a.grid.stages_with(b.grid)
-    ac, annz = np.asarray(a.cols), np.asarray(a.nnz)
-    br, bnnz = np.asarray(b.rows), np.asarray(b.nnz)
-    pr, pc = a.grid.pr, a.grid.pc
-    # nnz per row of every B tile
-    rowcounts = np.zeros((pr, pc, b.tile_m), np.int64)
-    for k in range(pr):
-        for j in range(pc):
-            n = bnnz[k, j]
-            np.add.at(rowcounts[k, j], br[k, j, :n], 1)
+    """Host-side shape oracle: (stage_flops_cap, out_cap) — the max
+    multiply count of any single (C-tile, interval) stage, and a bound
+    on any C tile's pre-dedup output tuples (clamped by the dense tile
+    size). One vectorized pass; no per-tile Python loops."""
+    _check_product(a, b)
+    intervals = _summa_intervals(a, b)
+    pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
+    ac = np.asarray(a.cols)                          # (pr, pc, cap)
+    annz = np.asarray(a.nnz)
+    br = np.asarray(b.rows)
+    bnnz = np.asarray(b.nnz)
+    bcap = br.shape[-1]
+
+    # per-tile row histogram of B: rowcnt[k, j, r] = nnz in row r
+    valid_b = np.arange(bcap)[None, None, :] < bnnz[:, :, None]
+    rowcnt = np.zeros((pr, pc, b.tile_m + 1), np.int64)
+    ti = np.broadcast_to(np.arange(pr)[:, None, None], br.shape)
+    tj = np.broadcast_to(np.arange(pc)[None, :, None], br.shape)
+    np.add.at(rowcnt, (ti, tj, np.where(valid_b, br, b.tile_m)), 1)
+    rowcnt[:, :, b.tile_m] = 0                       # padding bucket
+
+    valid_a = np.arange(cap)[None, None, :] < annz[:, :, None]
     stage_max = 0
     tile_total = np.zeros((pr, pc), np.int64)
-    for i in range(pr):
-        for k in range(stages):
-            n = annz[i, k]
-            acols = ac[i, k, :n]
-            for j in range(pc):
-                f = int(rowcounts[k, j][acols].sum())
-                stage_max = max(stage_max, f)
-                tile_total[i, j] += f
-    out_cap = int(min(tile_total.max(), a.tile_m * b.tile_n))
+    for (lo, hi, ja, la, ib, lb) in intervals:
+        L = hi - lo
+        p = ac[:, ja, :] - la                        # (pr, cap)
+        inr = valid_a[:, ja, :] & (p >= 0) & (p < L)
+        pos = lb + np.clip(p, 0, L - 1)
+        f_ij = np.empty((pr, pc), np.int64)
+        for j in range(pc):                          # O(pr*cap) temporaries
+            cj = rowcnt[ib, j][pos]                  # (pr, cap)
+            f_ij[:, j] = np.where(inr, cj, 0).sum(-1)
+        stage_max = max(stage_max, int(f_ij.max()))
+        tile_total += f_ij
+    out_cap = int(min(tile_total.max(),
+                      np.int64(a.tile_m) * np.int64(b.tile_n)))
     return max(stage_max, 1), max(out_cap, 1)
+
+
+def plan_flops_total(a: DistSpMat, b: DistSpMat) -> int:
+    """Total multiply count of A·B (for phase-count selection)."""
+    _check_product(a, b)
+    br = np.asarray(b.rows)
+    bnnz = np.asarray(b.nnz)
+    bcap = br.shape[-1]
+    valid_b = np.arange(bcap)[None, None, :] < bnnz[:, :, None]
+    # global row degree of B (summed over tile columns)
+    pr, pc = a.grid.pr, a.grid.pc
+    rowdeg = np.zeros((pr, b.tile_m + 1), np.int64)
+    ti = np.broadcast_to(np.arange(pr)[:, None, None], br.shape)
+    np.add.at(rowdeg, (ti, np.where(valid_b, br, b.tile_m)), 1)
+    rowdeg = rowdeg[:, :b.tile_m].reshape(-1)        # (pr*tile_m,)
+    ac = np.asarray(a.cols)
+    annz = np.asarray(a.nnz)
+    valid_a = np.arange(a.cap)[None, None, :] < annz[:, :, None]
+    # A's column j (local, tile col k) refers to global inner k*tile_n+j
+    gcol = ac + (np.arange(pc)[None, :, None] * a.tile_n)
+    gcol = np.where(valid_a, gcol, 0)
+    counts = rowdeg[np.clip(gcol, 0, rowdeg.shape[0] - 1)]
+    return int(np.where(valid_a, counts, 0).sum())
+
+
+# ---------------------------------------------------------------------------
+# Streaming SUMMA (≅ Mult_AnXBn_Synch, ParFriends.h:1005)
+# ---------------------------------------------------------------------------
+
+def _bcast_tile(r, c, v, n, is_src, axis, nrows, ncols):
+    """Broadcast one device's tile along a mesh axis: masked psum with
+    a single contributor (≅ BCastMatrix, SpParHelper.cpp:583)."""
+    r2 = lax.psum(jnp.where(is_src, r, 0), axis)
+    c2 = lax.psum(jnp.where(is_src, c, 0), axis)
+    if v.dtype == jnp.bool_:
+        v2 = lax.psum(jnp.where(is_src, v.astype(jnp.int32), 0),
+                      axis).astype(jnp.bool_)
+    else:
+        v2 = lax.psum(jnp.where(is_src, v, jnp.zeros((), v.dtype)), axis)
+    n2 = lax.psum(jnp.where(is_src, n, 0), axis)
+    return tl.Tile(r2, c2, v2, n2, nrows, ncols)
 
 
 @partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap"))
 def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
           flops_cap: int, out_cap: int) -> DistSpMat:
-    """C = A ⊗ B by sparse SUMMA (≅ Mult_AnXBn_Synch ParFriends.h:1005).
+    """C = A ⊗ B by streaming sparse SUMMA on any grid.
 
-    ``flops_cap`` bounds each stage's local multiply; ``out_cap`` is
-    the result's per-tile capacity. Size both with `plan_spgemm`.
+    ``flops_cap`` bounds each stage's local multiply expansion;
+    ``out_cap`` is the result's per-tile capacity. Size both with
+    `plan_spgemm`. Peak per-device memory is O(cap + flops_cap +
+    out_cap): one broadcast tile pair in flight, stage outputs folded
+    into the accumulator immediately.
     """
-    stages = a.grid.stages_with(b.grid)
-    if a.ncols != b.nrows or a.tile_n != b.tile_m:
-        raise ValueError("DIMMISMATCH: A ncols != B nrows")
+    _check_product(a, b)
+    intervals = _summa_intervals(a, b)
     mesh = a.grid.mesh
-    stage_cap = min(flops_cap, out_cap * stages)  # per-stage output tuples
+    tile_m, tile_nb = a.tile_m, b.tile_n
+    stage_cap = min(flops_cap, out_cap)
+    out_dtype = jax.eval_shape(
+        sr.multiply, jax.ShapeDtypeStruct((), a.dtype),
+        jax.ShapeDtypeStruct((), b.dtype)).dtype
 
-    def f(ar, ac, av, annz, br, bc, bv, bnnz):
-        ar, ac, av, annz = ar[0, 0], ac[0, 0], av[0, 0], annz[0, 0]
-        br, bc, bv, bnnz = br[0, 0], bc[0, 0], bv[0, 0], bnnz[0, 0]
-        # fan-out: my A tile to my grid row, my B tile to my grid column
-        # (≅ the two BCastMatrix calls per stage, SpParHelper.cpp:583)
-        gar = lax.all_gather(ar, COL_AXIS)
-        gac = lax.all_gather(ac, COL_AXIS)
-        gav = lax.all_gather(av, COL_AXIS)
-        gan = lax.all_gather(annz, COL_AXIS)
-        gbr = lax.all_gather(br, ROW_AXIS)
-        gbc = lax.all_gather(bc, ROW_AXIS)
-        gbv = lax.all_gather(bv, ROW_AXIS)
-        gbn = lax.all_gather(bnnz, ROW_AXIS)
-        partials = []
-        for k in range(stages):
-            at = tl.Tile(gar[k], gac[k], gav[k], gan[k], a.tile_m, a.tile_n)
-            bt = tl.Tile(gbr[k], gbc[k], gbv[k], gbn[k], b.tile_m, b.tile_n)
-            partials.append(tl.spgemm(sr, at, bt, flops_cap=flops_cap,
-                                      out_cap=stage_cap))
-        c = tl.concat_merge(sr.add, partials, cap=out_cap)
-        return (c.rows[None, None], c.cols[None, None],
-                c.vals[None, None], c.nnz[None, None])
+    def f(ar, ac, av, an, br, bc, bv, bn):
+        my_r = lax.axis_index(ROW_AXIS)
+        my_c = lax.axis_index(COL_AXIS)
+        ar, ac, av, an = ar[0, 0], ac[0, 0], av[0, 0], an[0, 0]
+        br, bc, bv, bn = br[0, 0], bc[0, 0], bv[0, 0], bn[0, 0]
+        acc = tl.empty(tile_m, tile_nb, out_cap, out_dtype)
+        for (lo, hi, ja, la, ib, lb) in intervals:
+            at = _bcast_tile(ar, ac, av, an, my_c == ja, COL_AXIS,
+                             a.tile_m, a.tile_n)
+            bt = _bcast_tile(br, bc, bv, bn, my_r == ib, ROW_AXIS,
+                             b.tile_m, b.tile_n)
+            part = tl.spgemm_ranged(sr, at, bt, a_lo=la, b_lo=lb,
+                                    length=hi - lo, flops_cap=flops_cap,
+                                    out_cap=stage_cap)
+            acc = tl.concat_merge(sr.add, [acc, part], cap=out_cap)
+        return (acc.rows[None, None], acc.cols[None, None],
+                acc.vals[None, None], acc.nnz[None, None])
 
     spec3 = P(ROW_AXIS, COL_AXIS, None)
     spec2 = P(ROW_AXIS, COL_AXIS)
@@ -105,3 +215,147 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     )(a.rows, a.cols, a.vals, a.nnz, b.rows, b.cols, b.vals, b.nnz)
     return DistSpMat(cr, cc, cv, cn, a.grid, a.nrows, b.ncols,
                      a.tile_m, b.tile_n)
+
+
+def _planned_summa(sr: Semiring, a: DistSpMat, b: DistSpMat,
+                   cap_round: int, what: str) -> DistSpMat:
+    """plan + round caps (for compile reuse) + saturation guard + summa."""
+    fc, oc = plan_spgemm(a, b)
+    fc = -(-fc // cap_round) * cap_round
+    oc = -(-oc // cap_round) * cap_round
+    if fc > _SAT:
+        raise ValueError(
+            f"{what} needs a {fc}-slot expansion (> 2^30); "
+            "use spgemm_phased (or more phases)")
+    return summa(sr, a, b, flops_cap=fc, out_cap=oc)
+
+
+def spgemm(sr: Semiring, a: DistSpMat, b: DistSpMat,
+           cap_round: int = 4096) -> DistSpMat:
+    """Plan + multiply in one call (caps rounded up to multiples of
+    ``cap_round`` so repeated products of similar size reuse the
+    compiled SUMMA)."""
+    return _planned_summa(sr, a, b, cap_round, "single-shot SUMMA")
+
+
+# ---------------------------------------------------------------------------
+# Phased, memory-bounded SpGEMM (≅ MemEfficientSpGEMM, ParFriends.h:450)
+# ---------------------------------------------------------------------------
+
+def _col_window(b: DistSpMat, lo: int, w: int) -> DistSpMat:
+    """Per-tile local column window [lo, lo+w) of B (≅ ColSplit,
+    dcsc.h:101). Globally: the same window of every tile column. The
+    window's capacity shrinks to its true max tile nnz (lane-aligned)
+    so per-stage broadcast volume scales with the window, not with B.
+    """
+    pr, pc, cap = b.grid.pr, b.grid.pc, b.cap
+    hi = min(lo + w, b.tile_n)
+
+    def one(rows, cols, vals, nnz):
+        t = tl.Tile(rows, cols, vals, nnz, b.tile_m, b.tile_n)
+        return ta.col_slice(t, lo, hi, cap)
+
+    out = jax.vmap(one)(b.rows.reshape(-1, cap), b.cols.reshape(-1, cap),
+                        b.vals.reshape(-1, cap), b.nnz.reshape(-1))
+    # col_slice compacts live entries to the front, so truncating to the
+    # observed max nnz (one host sync per phase, in the host-side phase
+    # loop anyway) is lossless
+    wcap = min(cap, max(128, -(-int(np.asarray(out.nnz).max()) // 128) * 128))
+    return DistSpMat(out.rows[:, :wcap].reshape(pr, pc, wcap),
+                     out.cols[:, :wcap].reshape(pr, pc, wcap),
+                     out.vals[:, :wcap].reshape(pr, pc, wcap),
+                     out.nnz.reshape(pr, pc),
+                     b.grid, b.nrows, b.grid.pc * (hi - lo),
+                     b.tile_m, hi - lo)
+
+
+def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
+                  phases: Optional[int] = None,
+                  phase_flop_budget: int = 2 ** 28,
+                  prune_hook: Optional[Callable[[DistSpMat], DistSpMat]] = None,
+                  out_cap: Optional[int] = None,
+                  cap_round: int = 4096) -> DistSpMat:
+    """C = A ⊗ B with B column-split into phases, each multiplied under
+    its own flop budget, optionally pruned between phases, then
+    concatenated (≅ MemEfficientSpGEMM, ParFriends.h:450-733).
+
+    ``phases=None`` auto-selects ceil(total_flops / phase_flop_budget)
+    (≅ CalculateNumberOfPhases, ParFriends.h:733). ``prune_hook``
+    receives each phase's C slice (a DistSpMat whose columns are true C
+    columns) and returns the pruned slice — the MCLPruneRecoverySelect
+    attachment point. This is the route past the 2^30 single-multiply
+    expansion ceiling: per-phase expansions stay small regardless of
+    total FLOPs.
+    """
+    _check_product(a, b)
+    if phases is None:
+        total = plan_flops_total(a, b)
+        phases = max(1, -(-total // phase_flop_budget))
+    phases = min(phases, b.tile_n)
+    w = -(-b.tile_n // phases)
+    phases = -(-b.tile_n // w)
+
+    parts = []
+    for p in range(phases):
+        lo = p * w
+        bp = _col_window(b, lo, w)
+        cp = _planned_summa(sr, a, bp, cap_round,
+                            f"phase {p}/{phases} of phased SpGEMM")
+        if prune_hook is not None:
+            cp = prune_hook(cp)
+        parts.append(cp)
+
+    # concatenate phase windows back into full-width tiles; a
+    # user-supplied out_cap must hold every surviving entry (no silent
+    # dropping — from_global_coo's contract)
+    need = int(np.asarray(sum(np.asarray(p.nnz, np.int64)
+                              for p in parts)).max())
+    if out_cap is None:
+        out_cap = max(128, -(-need // cap_round) * cap_round)
+    elif out_cap < need:
+        raise ValueError(
+            f"out_cap {out_cap} < {need} surviving entries in the "
+            "fullest tile; concatenation would silently drop")
+    pr, pc = a.grid.pr, a.grid.pc
+
+    def cat(*tiles_flat):
+        ts = []
+        i = 0
+        for part in parts:
+            r, c, v, n = tiles_flat[i:i + 4]
+            i += 4
+            ts.append(tl.Tile(r, c, v, n, a.tile_m, part.tile_n))
+        return ta.col_concat(ts, cap=out_cap)
+
+    args = []
+    for part in parts:
+        args += [part.rows.reshape(-1, part.cap),
+                 part.cols.reshape(-1, part.cap),
+                 part.vals.reshape(-1, part.cap),
+                 part.nnz.reshape(-1)]
+    out = jax.vmap(cat)(*args)
+    oc = out.rows.shape[-1]
+    shard3 = a.grid.sharding(ROW_AXIS, COL_AXIS, None)
+    shard2 = a.grid.sharding(ROW_AXIS, COL_AXIS)
+    return DistSpMat(
+        jax.device_put(out.rows.reshape(pr, pc, oc), shard3),
+        jax.device_put(out.cols.reshape(pr, pc, oc), shard3),
+        jax.device_put(out.vals.reshape(pr, pc, oc), shard3),
+        jax.device_put(out.nnz.reshape(pr, pc), shard2),
+        a.grid, a.nrows, b.ncols, a.tile_m, b.tile_n)
+
+
+def block_spgemm(sr: Semiring, a: DistSpMat, b: DistSpMat,
+                 col_blocks: int, cap_round: int = 4096):
+    """Generator yielding (block_index, local_col_range, C_block) one
+    output column block at a time (≅ BlockSpGEMM::getNextBlock,
+    BlockSpGEMM.h:50-75) — stream huge outputs without materializing C.
+    C_block's tile columns are B's local windows [lo, hi)."""
+    _check_product(a, b)
+    col_blocks = min(col_blocks, b.tile_n)
+    w = -(-b.tile_n // col_blocks)
+    for p in range(-(-b.tile_n // w)):
+        lo = p * w
+        bp = _col_window(b, lo, w)
+        yield p, (lo, min(lo + w, b.tile_n)), _planned_summa(
+            sr, a, bp, cap_round, f"block {p} of block SpGEMM")
