@@ -1,49 +1,129 @@
 #!/usr/bin/env python
-"""Headline benchmark: Graph500 BFS TEPS on R-MAT (BASELINE.json metric).
+"""Headline benchmark: both BASELINE.json metrics at the baseline's
+config — Graph500 BFS GTEPS (scale 22, edgefactor 16, 64 roots, one
+spec-validated root) and R-MAT A*A SpGEMM nnz/sec/chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N,
+   "extra_metrics": [{... spgemm nnz/sec/chip ...}], ...}
 
-vs_baseline is against the reference's strongest committed in-tree BFS
-log on comparable scale: 173.0 MTEPS median, Graph500 scale-22 ef16 on
-64 MPI ranks (BASELINE.md; CarverResults/scale22_p64_july11.run). This
-benchmark runs on however many TPU chips are visible (usually one).
+vs_baseline compares the BFS median against the reference's strongest
+committed in-tree log at the SAME config: 173.0 MTEPS median, Graph500
+scale-22 ef16 on 64 MPI ranks (BASELINE.md;
+CarverResults/scale22_p64_july11.run). The SpGEMM baseline is the
+in-tree scale-22 single-core log (124.1 s/multiply,
+ReleaseTests/SCALE22RMATRMAT/btwcent1.1254794.out); its nnz/sec
+derives from the product size at the benchmarked scale. Runs on
+however many TPU chips are visible (usually one).
 """
 
 import argparse
 import json
 import sys
+import time
 
 BASELINE_GTEPS = 0.173
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--scale", type=int, default=20)
-    ap.add_argument("--edgefactor", type=int, default=16)
-    ap.add_argument("--nroots", type=int, default=8)
-    ap.add_argument("--verbose", action="store_true")
-    args = ap.parse_args()
-
-    import jax
+def bench_bfs(args):
     from combblas_tpu.models import bfs as B
     from combblas_tpu.parallel.grid import ProcGrid
 
     grid = ProcGrid.make()
     stats = B.graph500_run(grid, scale=args.scale,
                            edgefactor=args.edgefactor,
-                           nroots=args.nroots, verbose=args.verbose)
-    s = stats.summary()
+                           nroots=args.nroots,
+                           validate_roots=args.validate_roots,
+                           verbose=args.verbose)
+    return stats.summary()
+
+
+def bench_spgemm(args):
+    """R-MAT scale-S A*A via phased SUMMA; nnz(C)/sec/chip."""
+    import jax
+    import jax.numpy as jnp
+    from combblas_tpu.ops import generate
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel import spgemm as spg
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    grid = ProcGrid.make()
+    n = 1 << args.spgemm_scale
+    r, c = generate.rmat_edges(jax.random.key(args.seed),
+                               args.spgemm_scale, args.edgefactor)
+    a = dm.from_global_coo(S.PLUS, grid, r, c,
+                           jnp.ones_like(r, jnp.float32), n, n)
+    jax.block_until_ready(a.rows)
+    # warm-up (compile) then timed run
+    cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
+                           phase_flop_budget=args.phase_flop_budget)
+    cm.vals.block_until_ready()
+    t0 = time.perf_counter()
+    cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
+                           phase_flop_budget=args.phase_flop_budget)
+    cm.vals.block_until_ready()
+    dt = time.perf_counter() - t0
+    nnz = cm.getnnz()
+    return {"scale": args.spgemm_scale, "c_nnz": nnz, "seconds": dt,
+            "nnz_per_sec_per_chip": nnz / dt / max(1, len(jax.devices()))}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=22,
+                    help="BFS scale (baseline config: 22)")
+    ap.add_argument("--edgefactor", type=int, default=16)
+    ap.add_argument("--nroots", type=int, default=64,
+                    help="Graph500 recipe: 64 random roots")
+    ap.add_argument("--validate-roots", type=int, default=1,
+                    help="spec-validate this many roots (untimed)")
+    ap.add_argument("--spgemm-scale", type=int, default=16,
+                    help="A*A benchmark scale (largest feasible "
+                         "single-chip; baseline metric names scale 22 — "
+                         "the JSON states the actual scale)")
+    ap.add_argument("--phase-flop-budget", type=int, default=2 ** 27)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--skip-spgemm", action="store_true")
+    ap.add_argument("--verbose", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+    nchips = len(jax.devices())
+
+    s = bench_bfs(args)
     gteps = s["median_teps"] / 1e9
+
+    extra = []
+    if not args.skip_spgemm:
+        try:
+            sp = bench_spgemm(args)
+            extra.append({
+                "metric": f"rmat_scale{sp['scale']}_AxA_nnz_per_sec_per_chip",
+                "value": round(sp["nnz_per_sec_per_chip"], 1),
+                "unit": "nnz/s/chip",
+                "c_nnz": sp["c_nnz"],
+                "seconds": round(sp["seconds"], 3),
+                "note": f"largest feasible single-chip scale "
+                        f"{sp['scale']} (baseline metric names scale 22)",
+            })
+        except Exception as e:       # never lose the BFS headline
+            extra.append({"metric": "spgemm_bench_error", "error": str(e)})
+
     print(json.dumps({
         "metric": f"graph500_bfs_scale{args.scale}_ef{args.edgefactor}_"
-                  f"{len(jax.devices())}chip_median",
+                  f"{nchips}chip_median",
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / BASELINE_GTEPS, 3),
         "baseline": f"{BASELINE_GTEPS} GTEPS median, Graph500 scale-22 "
                     "ef16, 64 MPI ranks (CarverResults/scale22_p64_july11"
                     ".run)",
+        "nroots": args.nroots,
+        "validated_roots": args.validate_roots,
+        "min_gteps": round(s["min_teps"] / 1e9, 4),
+        "harmonic_mean_gteps": round(s["harmonic_mean_teps"] / 1e9, 4),
+        "extra_metrics": extra,
     }))
 
 

@@ -1,12 +1,17 @@
 """combblas_tpu — a TPU-native combinatorial (sparse, semiring) BLAS.
 
-A brand-new JAX/XLA/Pallas framework with the capabilities of CombBLAS
-(the Combinatorial BLAS, reference: /root/reference): distributed semiring
-sparse linear algebra — SpGEMM, SpMV/SpMSpV, elementwise ops, reductions,
-k-select, indexing/assignment — over a 2D (optionally 3D) device mesh,
-plus the graph applications built on those primitives (Graph500 BFS,
-connected components, betweenness centrality, Markov clustering,
-matchings, orderings).
+A brand-new JAX/XLA framework with the capabilities of CombBLAS (the
+Combinatorial BLAS, reference: /root/reference): distributed semiring
+sparse linear algebra — streaming/phased SUMMA SpGEMM (2D and 3D
+grids), SpMV/SpMSpV/SpMM, elementwise ops, reductions, k-select,
+indexing/assignment (`parallel.algebra`, `parallel.indexing`) — over a
+2D (optionally 3D) device mesh, plus the graph applications built on
+those primitives (Graph500 direction-optimizing BFS and its variants,
+FastSV connected components, betweenness centrality, MCL Markov
+clustering, maximal/maximum/auction matchings, Luby MIS, RCM and
+minimum-degree orderings), Matrix Market / binary I/O with a native
+C++ parser (`io`), and the timing/config auxiliary subsystems
+(`utils`).
 
 Design (TPU-first, not a port):
   * Local storage is a static-shape, padded, (row, col)-sorted COO tile

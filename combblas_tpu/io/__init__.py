@@ -1,0 +1,8 @@
+"""File I/O: Matrix Market (native-parser-backed), vector files, and
+binary checkpoints (≅ reference L7, SURVEY §2.7)."""
+
+from combblas_tpu.io.mmio import (
+    MMHeader, read_mm_header, read_mm_coo, read_mm, write_mm,
+    read_vec, write_vec, save_matrix, load_matrix, save_vector,
+    load_vector,
+)

@@ -1,0 +1,57 @@
+"""Build + load the native Matrix Market parser (ctypes).
+
+The reference vendors mmio as C (src/mmio.c) built by CMake; here the
+parser compiles on first use with g++ into combblas_tpu/io/_build/ and
+is loaded via ctypes (this environment has no pybind11). A missing
+toolchain degrades gracefully: `load()` returns None and callers fall
+back to the pure-Python parser.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import pathlib
+import subprocess
+
+_DIR = pathlib.Path(__file__).parent
+_SRC = _DIR / "_mmparse.cpp"
+_BUILD = _DIR / "_build"
+
+_lib = None
+_tried = False
+
+
+def load():
+    """The loaded CDLL, building it if needed; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        tag = hashlib.sha1(_SRC.read_bytes()).hexdigest()[:12]
+        so = _BUILD / f"_mmparse_{tag}.so"
+        if not so.exists():
+            _BUILD.mkdir(exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(so)],
+                check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(str(so))
+        lib.mm_read_header.restype = ctypes.c_int
+        lib.mm_read_header.argtypes = [ctypes.c_char_p,
+                                       ctypes.POINTER(ctypes.c_longlong)]
+        lib.mm_read_body.restype = ctypes.c_longlong
+        lib.mm_read_body.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_longlong]
+        lib.mm_write.restype = ctypes.c_int
+        lib.mm_write.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+            ctypes.c_int]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib

@@ -1,0 +1,225 @@
+"""Matrix Market read/write for distributed matrices and vectors.
+
+Capability parity: `ParallelReadMM` (SpParMat.cpp:3922),
+`ParallelWriteMM` (SpParMat.h:278), mmio banner handling (src/mmio.c),
+vector read/write (FullyDistSpVec.cpp:1209,1310).
+
+TPU-native re-design: parsing is one native pass (io/_mmparse.cpp via
+ctypes; pure-Python fallback) into host numpy buffers; distribution is
+the on-device tuple shuffle of `distmat.from_global_coo` (the
+SparseCommon AlltoAll of SpParMat.cpp:2835 as one sharded build). The
+reference's MPI-IO byte-range splitting has no analogue: a TPU host
+owns file I/O, the mesh owns placement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu.io import _native
+from combblas_tpu.ops.semiring import Monoid, PLUS
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS
+
+
+@dataclasses.dataclass
+class MMHeader:
+    nrows: int
+    ncols: int
+    nnz: int
+    pattern: bool
+    symmetric: bool
+    skew: bool
+    hermitian: bool
+    complex_: bool
+
+
+def read_mm_header(path) -> MMHeader:
+    path = str(path)
+    lib = _native.load()
+    if lib is not None:
+        import ctypes
+        hdr = (ctypes.c_longlong * 8)()
+        rc = lib.mm_read_header(path.encode(), hdr)
+        if rc != 0:
+            raise ValueError(f"not a Matrix Market coordinate file "
+                             f"({path}, rc={rc})")
+        return MMHeader(int(hdr[0]), int(hdr[1]), int(hdr[2]),
+                        bool(hdr[3]), bool(hdr[4]), bool(hdr[5]),
+                        bool(hdr[6]), bool(hdr[7]))
+    return _py_header(path)
+
+
+def _py_header(path) -> MMHeader:
+    with open(path) as f:
+        banner = f.readline()
+        if not banner.startswith("%%MatrixMarket"):
+            raise ValueError(f"not a Matrix Market file: {path}")
+        low = banner.lower()
+        if "coordinate" not in low:
+            raise ValueError("only coordinate (sparse) files supported")
+        line = f.readline()
+        while line.startswith("%"):
+            line = f.readline()
+        m, n, nnz = (int(x) for x in line.split())
+        return MMHeader(m, n, nnz, "pattern" in low,
+                        "symmetric" in low and "skew" not in low,
+                        "skew-symmetric" in low, "hermitian" in low,
+                        "complex" in low)
+
+
+def read_mm_coo(path) -> tuple[np.ndarray, np.ndarray, np.ndarray, MMHeader]:
+    """(rows, cols, vals, header) with symmetric/skew completion already
+    applied (≅ the symmetric completion inside ParallelReadMM). Complex
+    files keep the real part, like the reference's double handler."""
+    path = str(path)
+    h = read_mm_header(path)
+    lib = _native.load()
+    if lib is not None:
+        import ctypes
+        rows = np.empty(h.nnz, np.int32)
+        cols = np.empty(h.nnz, np.int32)
+        vals = np.empty(h.nnz, np.float64)
+        got = lib.mm_read_body(
+            path.encode(),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), h.nnz)
+        if got < 0:
+            raise ValueError(f"parse error in {path} (rc={got})")
+        rows, cols, vals = rows[:got], cols[:got], vals[:got]
+    else:
+        data = []
+        with open(path) as f:
+            f.readline()
+            line = f.readline()
+            while line.startswith("%"):
+                line = f.readline()
+            for line in f:
+                parts = line.split()
+                if not parts or parts[0].startswith("%"):
+                    continue
+                r, c = int(parts[0]) - 1, int(parts[1]) - 1
+                v = float(parts[2]) if (len(parts) > 2 and not h.pattern) \
+                    else 1.0
+                data.append((r, c, v))
+        arr = np.array(data, np.float64).reshape(-1, 3)
+        rows = arr[:, 0].astype(np.int32)
+        cols = arr[:, 1].astype(np.int32)
+        vals = arr[:, 2]
+
+    if h.symmetric or h.skew or h.hermitian:
+        off = rows != cols
+        mr, mc, mv = cols[off], rows[off], vals[off]
+        if h.skew:
+            mv = -mv
+        rows = np.concatenate([rows, mr])
+        cols = np.concatenate([cols, mc])
+        vals = np.concatenate([vals, mv])
+    return rows, cols, vals, h
+
+
+def read_mm(add: Monoid, grid: ProcGrid, path, dtype=jnp.float32,
+            cap: Optional[int] = None) -> dm.DistSpMat:
+    """Parse + distribute (≅ ParallelReadMM, SpParMat.cpp:3922)."""
+    rows, cols, vals, h = read_mm_coo(path)
+    return dm.from_global_coo(add, grid, rows, cols,
+                              jnp.asarray(vals.astype(dtype)),
+                              h.nrows, h.ncols, cap=cap)
+
+
+def write_mm(path, a: dm.DistSpMat, pattern: bool = False) -> None:
+    """Gather + write coordinate file (≅ ParallelWriteMM,
+    SpParMat.h:278 — rank-0 gather variant; the byte-offset-coordinated
+    parallel write has no analogue on a single-host mesh)."""
+    rows, cols, vals = dm.to_global_coo(a)
+    path = str(path)
+    lib = _native.load()
+    vals64 = np.asarray(vals, np.float64)
+    rows = np.ascontiguousarray(rows, np.int32)
+    cols = np.ascontiguousarray(cols, np.int32)
+    vals64 = np.ascontiguousarray(vals64)
+    if lib is not None:
+        import ctypes
+        rc = lib.mm_write(
+            path.encode(),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            vals64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            len(rows), a.nrows, a.ncols, int(pattern))
+        if rc != 0:
+            raise OSError(f"mm_write failed (rc={rc})")
+        return
+    with open(path, "w") as f:
+        kind = "pattern" if pattern else "real"
+        f.write(f"%%MatrixMarket matrix coordinate {kind} general\n")
+        f.write(f"{a.nrows} {a.ncols} {len(rows)}\n")
+        for r, c, v in zip(rows, cols, vals64):
+            if pattern:
+                f.write(f"{r + 1} {c + 1}\n")
+            else:
+                f.write(f"{r + 1} {c + 1} {v:.17g}\n")
+
+
+# ---------------------------------------------------------------------------
+# Vector I/O (≅ FullyDistSpVec::ParallelRead/Write, :1209/1310)
+# ---------------------------------------------------------------------------
+
+def write_vec(path, v: dv.DistVec) -> None:
+    """index value lines, 1-based (the reference's vector format)."""
+    vals = v.to_global()
+    with open(path, "w") as f:
+        f.write(f"{v.glen}\n")
+        for i, x in enumerate(vals):
+            f.write(f"{i + 1} {x}\n")
+
+
+def read_vec(grid: ProcGrid, path, axis: str = ROW_AXIS,
+             dtype=jnp.float32) -> dv.DistVec:
+    with open(path) as f:
+        glen = int(f.readline())
+        out = np.zeros(glen, np.float64)
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                out[int(parts[0]) - 1] = float(parts[1])
+    return dv.from_global(grid, axis, jnp.asarray(out.astype(dtype)))
+
+
+# ---------------------------------------------------------------------------
+# Binary checkpoint (≅ ParallelBinaryWrite SpParMat.cpp:620 /
+# checkpoint-by-persistence, SURVEY §5)
+# ---------------------------------------------------------------------------
+
+def save_matrix(path, a: dm.DistSpMat) -> None:
+    """One-file binary snapshot of a distributed matrix (tiles +
+    layout metadata). Grid-shape-independent restore: entries are
+    stored as global COO."""
+    rows, cols, vals = dm.to_global_coo(a)
+    np.savez_compressed(path, rows=rows, cols=cols, vals=vals,
+                        shape=np.array([a.nrows, a.ncols], np.int64))
+
+
+def load_matrix(add: Monoid, grid: ProcGrid, path,
+                cap: Optional[int] = None) -> dm.DistSpMat:
+    with np.load(path) as z:
+        nrows, ncols = (int(x) for x in z["shape"])
+        return dm.from_global_coo(add, grid, z["rows"], z["cols"],
+                                  jnp.asarray(z["vals"]), nrows, ncols,
+                                  cap=cap, dedup=False)
+
+
+def save_vector(path, v: dv.DistVec) -> None:
+    np.savez_compressed(path, data=v.to_global(),
+                        glen=np.int64(v.glen))
+
+
+def load_vector(grid: ProcGrid, path, axis: str = ROW_AXIS) -> dv.DistVec:
+    with np.load(path) as z:
+        return dv.from_global(grid, axis, jnp.asarray(z["data"]))

@@ -1,2 +1,7 @@
 """Graph applications built on the distributed primitives
-(≅ the reference's Applications/ tree)."""
+(≅ the reference's Applications/ tree): Graph500 direction-optimizing
+BFS + variants (random-parent, min/max policy, filtered/semantic),
+FastSV connected components, MCL/HipMCL clustering, betweenness
+centrality, Luby (filtered) MIS, bipartite matchings (maximal greedy /
+Karp-Sipser, maximum augmenting-path, auction AWPM), and RCM/minimum-
+degree orderings."""

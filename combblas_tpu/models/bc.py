@@ -1,0 +1,105 @@
+"""Betweenness centrality — batched Brandes over SpMM.
+
+Capability parity: Applications/BetwCent.cpp:146-230 (batched Brandes:
+forward BFS-DAG construction via `PSpGEMM<PTBOOLINT>` on root batches
+with `SubsRefCol`, per-level fringe stack, backward dependency tally
+with `EWiseMult` and dense updates).
+
+TPU-native re-design: a batch of roots is one dense (n, batch)
+multi-vector, so the forward wave and the backward tally are SpMM
+calls (parallel.densemat.spmm) — the reference's boolean SpGEMM on an
+n×batch sparse fringe matrix becomes a dense batched SpMV riding
+contiguous lanes; level masks are stored as a stack of dense bit
+planes (the BFS-DAG stack of BetwCent.cpp:171).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel import densemat as dn
+from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
+
+
+def _to_cmv(y: dn.DistMultiVec, a: dm.DistSpMat) -> dn.DistMultiVec:
+    return dn.mv_realign(y, COL_AXIS, block=a.tile_n)
+
+
+def bc_batch(a: dm.DistSpMat, at: dm.DistSpMat,
+             roots: Sequence[int]) -> np.ndarray:
+    """Partial BC scores (n,) from one batch of source vertices.
+
+    Forward: nsp (shortest-path counts) grows level by level via
+    A^T-SpMM on the current fringe; level masks are stacked. Backward:
+    dependencies delta accumulate via A-SpMM of (1+delta)/nsp masked to
+    the deeper level (the Brandes tally; ≅ BetwCent.cpp:181-219).
+    Host-side level loop (depth is data-dependent); each level is one
+    jitted distributed SpMM.
+    """
+    n = a.nrows
+    b = len(roots)
+    roots = np.asarray(roots, np.int64)
+
+    nsp0 = np.zeros((n, b), np.float32)
+    nsp0[roots, np.arange(b)] = 1.0
+    nsp = dn.mv_from_global(a.grid, ROW_AXIS, nsp0)
+    fringe = nsp
+    visited = nsp0 != 0
+    levels = []                                   # per-level (n,b) masks
+
+    while True:
+        y = dn.spmm(S.PLUS_TIMES_F32, at, _to_cmv(fringe, at))
+        yg = y.to_global()
+        fresh = (yg != 0) & ~visited
+        if not fresh.any():
+            break
+        visited |= fresh
+        levels.append(fresh)
+        fg = np.where(fresh, yg, 0.0)
+        nspg = nsp.to_global() + fg
+        nsp = dn.mv_from_global(a.grid, ROW_AXIS, nspg)
+        fringe = dn.mv_from_global(a.grid, ROW_AXIS, fg)
+
+    nspg = nsp.to_global()
+    inv_nsp = np.where(nspg != 0, 1.0 / np.maximum(nspg, 1e-30), 0.0)
+    delta = np.zeros((n, b), np.float32)
+    for d in range(len(levels) - 1, -1, -1):
+        wd = levels[d]
+        t1 = np.where(wd, (1.0 + delta) * inv_nsp, 0.0)
+        t2 = dn.spmm(S.PLUS_TIMES_F32, a,
+                     _to_cmv(dn.mv_from_global(a.grid, ROW_AXIS, t1), a)
+                     ).to_global()
+        pred_mask = levels[d - 1] if d > 0 else (nsp0 != 0)
+        delta += np.where(pred_mask, nspg * t2, 0.0)
+
+    # a root's own accumulation row is excluded from its column's tally
+    delta[roots, np.arange(b)] = 0.0
+    return delta.sum(1)
+
+
+def betweenness_centrality(a: dm.DistSpMat, batch_size: int = 16,
+                           sources: Optional[Sequence[int]] = None,
+                           normalize: bool = False) -> np.ndarray:
+    """BC scores for a directed graph ``a`` (boolean adjacency,
+    a[i,j]=1 for edge i->j). ``sources=None`` runs every vertex as a
+    source (exact BC); a subset gives the approximate batched variant
+    the reference's CLI exposes (BetwCent.cpp main). Returns host (n,)
+    scores (≅ the reference gathers them for output too)."""
+    n = a.nrows
+    a = a.astype(jnp.float32)       # bool adjacency -> arithmetic 0/1
+    at = dm.transpose(a)
+    srcs = np.arange(n) if sources is None else np.asarray(sources)
+    scores = np.zeros(n, np.float32)
+    for lo in range(0, len(srcs), batch_size):
+        scores += bc_batch(a, at, srcs[lo:lo + batch_size])
+    if normalize and n > 2:
+        scores /= (n - 1) * (n - 2)
+    return scores

@@ -1,0 +1,166 @@
+"""BFS variants: parent-selection policies, level BFS, and filtered
+(semantic-graph) BFS.
+
+Capability parity: Applications/RandomParentBFS.cpp (a random priority
+rides the semiring; add = min-by-priority, :92-117),
+SingleChildBFS.cpp (SelectMaxSRing traversal with discovered-pruning,
+:116), FilteredBFS.cpp + TwitterEdge.h:15 (edge-attribute predicate
+evaluated inside the semiring multiply — the SemanticGraph concept,
+SemanticGraph.h), and the level/distance computation every ordering
+app uses (RCM.cpp's SpMV<SelectMinSR> level loop :361).
+
+TPU-native re-design: all variants share one jitted while_loop over
+the masked SpMSpV; the parent policy is the reduction monoid (max /
+min / min-random-priority with an inverse-permutation decode), and the
+edge filter composes into the multiply. These run the clean SpMSpV
+path — the tuned Graph500 kernel stays in models.bfs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops.semiring import Semiring, MAX, MIN
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel import spmv as pspmv
+from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
+
+NO_PARENT = -1
+_I32MAX = jnp.iinfo(jnp.int32).max
+_I32MIN = jnp.iinfo(jnp.int32).min
+
+
+def _sel2nd(x, y):
+    return y
+
+
+def _filtered_sel2nd(pred, monoid):
+    def mul(attr, x):
+        return jnp.where(pred(attr), x, monoid.identity(x.dtype))
+    return Semiring("filtered_sel2nd", monoid, mul)
+
+
+@partial(jax.jit, static_argnames=("policy", "pred", "max_iters"))
+def bfs_select(a: dm.DistSpMat, root, *, policy: str = "max",
+               key=None, pred=None, max_iters: int = 2 ** 30):
+    """Parents vector under a parent-selection ``policy``:
+
+      * "max"    — highest-id parent wins (SelectMaxSRing; ≅ TopDown/
+                   SingleChild traversals)
+      * "min"    — lowest-id parent wins (SelectMinSRing1)
+      * "random" — uniformly random parent among the frontier
+                   neighbors (≅ RandomParentBFS's priority semiring):
+                   ids are encoded through a random permutation, the
+                   min *priority* wins, and the inverse permutation
+                   decodes the winner. Needs ``key``.
+
+    ``pred`` (on edge values) makes this a filtered/semantic BFS
+    (≅ FilteredBFS: only edges passing the predicate are traversed).
+    Returns an r-aligned parents DistVec (NO_PARENT = unreached).
+    """
+    if a.nrows != a.ncols:
+        raise ValueError("bfs needs a square matrix")
+    n = a.nrows
+    grid = a.grid
+    tile_m, tile_n = a.tile_m, a.tile_n
+    rpad = grid.pr * tile_m - n
+    cpad = grid.pc * tile_n - n
+    root = jnp.asarray(root, jnp.int32)
+
+    if policy == "random":
+        if key is None:
+            raise ValueError("policy='random' needs a PRNG key")
+        perm = jax.random.permutation(key, n).astype(jnp.int32)
+        inv = jnp.zeros((n,), jnp.int32).at[perm].set(
+            jnp.arange(n, dtype=jnp.int32))
+        encode = lambda ids: perm[jnp.clip(ids, 0, n - 1)]
+        decode = lambda y: inv[jnp.clip(y, 0, n - 1)]
+        monoid, ident = MIN, _I32MAX
+    elif policy == "min":
+        encode = decode = lambda ids: ids
+        monoid, ident = MIN, _I32MAX
+    elif policy == "max":
+        encode = decode = lambda ids: ids
+        monoid, ident = MAX, _I32MIN
+    else:
+        raise ValueError(f"unknown policy {policy!r}")
+
+    keep = pred if pred is not None else None
+    sr = (_filtered_sel2nd(keep, monoid) if keep is not None
+          else Semiring(f"sel2nd_{monoid.name}", monoid, _sel2nd))
+
+    ids = jnp.arange(n, dtype=jnp.int32)
+
+    def body(carry):
+        parents, act, it, _ = carry
+        xval = jnp.pad(encode(ids), (0, cpad), constant_values=ident)
+        x = dv.DistSpVec(xval.reshape(grid.pc, tile_n),
+                         act.reshape(grid.pc, tile_n), grid, COL_AXIS, n)
+        y = pspmv.spmsv(sr, a, x)
+        yflat = y.data.reshape(-1)[:n]
+        # freshness from the reduced VALUE, not the raw hit mask: with
+        # an edge filter, a vertex whose frontier edges all fail the
+        # predicate still registers a hit but reduces to the identity
+        hit = y.active.reshape(-1)[:n] & (yflat != ident)
+        fresh = hit & (parents == NO_PARENT)
+        parents = jnp.where(fresh, decode(yflat), parents)
+        act_new = jnp.pad(fresh, (0, cpad), constant_values=False)
+        return parents, act_new, it + 1, jnp.any(fresh)
+
+    def cond(carry):
+        _, _, it, cont = carry
+        return cont & (it < max_iters)
+
+    parents0 = jnp.full((n,), NO_PARENT, jnp.int32).at[root].set(root)
+    act0 = jnp.zeros((n + cpad,), bool).at[root].set(True)
+    parents, _, _, _ = lax.while_loop(
+        cond, body, (parents0, act0, jnp.int32(0), jnp.bool_(True)))
+    data = jnp.pad(parents, (0, rpad), constant_values=NO_PARENT)
+    return dv.DistVec(data.reshape(grid.pr, tile_m), grid, ROW_AXIS, n)
+
+
+@partial(jax.jit, static_argnames=("pred", "max_iters"))
+def bfs_levels(a: dm.DistSpMat, root, pred=None,
+               max_iters: int = 2 ** 30) -> dv.DistVec:
+    """Distance-in-hops vector (-1 = unreached) — the level loop RCM
+    and the matchings build on (≅ RCM.cpp:361's SelectMinSR SpMV)."""
+    if a.nrows != a.ncols:
+        raise ValueError("bfs needs a square matrix")
+    n = a.nrows
+    grid = a.grid
+    tile_m, tile_n = a.tile_m, a.tile_n
+    rpad = grid.pr * tile_m - n
+    cpad = grid.pc * tile_n - n
+    root = jnp.asarray(root, jnp.int32)
+
+    sr = (_filtered_sel2nd(pred, S.LOR) if pred is not None
+          else S.BOOL_OR_AND)
+
+    def body(carry):
+        level, act, d, _ = carry
+        x = dv.DistSpVec(act.reshape(grid.pc, tile_n),
+                         act.reshape(grid.pc, tile_n), grid, COL_AXIS, n)
+        y = pspmv.spmsv(sr, a, x)
+        hit = y.active.reshape(-1)[:n] & y.data.reshape(-1)[:n].astype(bool)
+        fresh = hit & (level < 0)
+        level = jnp.where(fresh, d + 1, level)
+        act_new = jnp.pad(fresh, (0, cpad), constant_values=False)
+        return level, act_new, d + 1, jnp.any(fresh)
+
+    def cond(carry):
+        _, _, d, cont = carry
+        return cont & (d < max_iters)
+
+    level0 = jnp.full((n,), -1, jnp.int32).at[root].set(0)
+    act0 = jnp.zeros((n + cpad,), bool).at[root].set(True)
+    level, _, _, _ = lax.while_loop(
+        cond, body, (level0, act0, jnp.int32(0), jnp.bool_(True)))
+    data = jnp.pad(level, (0, rpad), constant_values=-1)
+    return dv.DistVec(data.reshape(grid.pr, tile_m), grid, ROW_AXIS, n)

@@ -1,0 +1,331 @@
+"""Bipartite matchings: greedy/Karp-Sipser maximal, augmenting-path
+maximum, and auction-based approximate weight matching.
+
+Capability parity: BipartiteMatchings/BPMaximalMatching.h:24
+(`MaximalMatching` greedy + Karp-Sipser init with Select2nd rings),
+BPMaximumMatching.cpp:206 (`maximumMatching` — Azad-Buluç augmenting
+paths over SpMV waves), ApproxWeightPerfectMatching.h (auction-style
+AWPM).
+
+TPU-native re-design: proposal rounds are masked SpMSpVs + vector
+scatter-max conflict resolution in one jitted while_loop (maximal);
+the maximum matching runs distributed BFS waves per phase with
+host-side path flipping (the reference's Extract/Assign augmentation
+collapses to parent-array walks — vectors are O(n) host-cheap); the
+auction computes per-row best/second-best profit with two masked
+row-reductions per round (a fully dense-vectorized bidding war).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops.semiring import Semiring, MAX, PLUS
+from combblas_tpu.parallel import algebra as alg
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel import spmv as pspmv
+from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
+
+_I32MIN = jnp.iinfo(jnp.int32).min
+
+
+def _sel2nd(x, y):
+    return y
+
+
+_SR_MAX2 = Semiring("sel2nd_max_i32", MAX, _sel2nd, jnp.int32)
+_SR_CNT = Semiring("count_active", PLUS, lambda v, x: x, jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("karp_sipser", "max_iters"))
+def maximal_matching(a: dm.DistSpMat, karp_sipser: bool = False,
+                     max_iters: int = 2 ** 30):
+    """Greedy maximal matching of the bipartite graph ``a`` (rows vs
+    cols). Returns (mate_row (nrows,), mate_col (ncols,)) flat arrays,
+    -1 = unmatched (≅ MaximalMatching, BPMaximalMatching.h:24).
+
+    Per round: every unmatched row proposes to its highest-id
+    unmatched neighbor column (Select2ndMax SpMSpV over the column
+    activity mask); each column accepts the highest proposing row
+    (scatter-max); accepted pairs leave both pools. With
+    ``karp_sipser``, rows whose remaining degree is 1 propose first
+    (the KS heuristic, :239), improving cardinality.
+    """
+    nr, nc = a.nrows, a.ncols
+    grid = a.grid
+    tile_n = a.tile_n
+    cpad = grid.pc * tile_n - nc
+
+    def cvec(vals, act, fill):
+        vv = jnp.pad(vals, (0, cpad), constant_values=fill)
+        aa = jnp.pad(act, (0, cpad), constant_values=False)
+        return dv.DistSpVec(vv.reshape(grid.pc, tile_n),
+                            aa.reshape(grid.pc, tile_n), grid, COL_AXIS, nc)
+
+    colids = jnp.arange(nc, dtype=jnp.int32)
+    rowids = jnp.arange(nr, dtype=jnp.int32)
+
+    def body(carry):
+        mrow, mcol, it, _ = carry
+        col_free = mcol < 0
+        row_free = mrow < 0
+        # highest free neighbor column per row
+        y = pspmv.spmsv(_SR_MAX2, a, cvec(colids, col_free, 0))
+        pick = y.data.reshape(-1)[:nr]
+        has = y.active.reshape(-1)[:nr] & row_free
+        if karp_sipser:
+            ydeg = pspmv.spmsv(_SR_CNT, a, cvec(
+                jnp.ones((nc,), jnp.int32), col_free, 0))
+            deg = jnp.where(ydeg.active.reshape(-1)[:nr],
+                            ydeg.data.reshape(-1)[:nr], 0)
+            deg1 = has & (deg == 1)
+            has = jnp.where(jnp.any(deg1), deg1, has)
+        # conflict resolution: column takes the max proposing row
+        tgt = jnp.where(has, jnp.clip(pick, 0, nc - 1), nc)
+        taker = jnp.full((nc + 1,), _I32MIN, jnp.int32)
+        taker = taker.at[tgt].max(rowids, mode="drop")[:nc]
+        won = has & (taker[jnp.clip(pick, 0, nc - 1)] == rowids)
+        mrow = jnp.where(won, pick, mrow)
+        mcol = mcol.at[jnp.where(won, pick, nc)].set(
+            jnp.where(won, rowids, -1), mode="drop")
+        return mrow, mcol, it + 1, jnp.any(won)
+
+    def cond(carry):
+        _, _, it, progressed = carry
+        return progressed & (it < max_iters)
+
+    mrow0 = jnp.full((nr,), -1, jnp.int32)
+    mcol0 = jnp.full((nc,), -1, jnp.int32)
+    mrow, mcol, _, _ = lax.while_loop(
+        cond, body, (mrow0, mcol0, jnp.int32(0), jnp.bool_(True)))
+    return mrow, mcol
+
+
+def maximum_matching(a: dm.DistSpMat, init: str = "greedy"):
+    """Maximum-cardinality bipartite matching (≅ maximumMatching,
+    BPMaximumMatching.cpp:206). Returns (mate_row, mate_col) numpy.
+
+    Phases of {distributed BFS wave from free rows; host-side flipping
+    of vertex-disjoint augmenting paths via parent-array walks} until
+    no augmenting path exists — the Azad-Buluç structure with the
+    reference's distributed vector Extract/Assign steps done on the
+    gathered O(n) parent arrays.
+    """
+    nr, nc = a.nrows, a.ncols
+    at = dm.transpose(a)
+    grid = a.grid
+    if init == "greedy":
+        mrow, mcol = (np.array(x) for x in maximal_matching(a))
+    else:
+        mrow = np.full(nr, -1, np.int32)
+        mcol = np.full(nc, -1, np.int32)
+
+    tile_nr = at.tile_n          # = a's row blocking on the c axis of A^T
+    cpad_r = grid.pc * tile_nr - nr
+    rowids = jnp.arange(nr, dtype=jnp.int32)
+
+    def reach_cols(row_mask):
+        """One wave: per column, the max frontier row with an edge."""
+        vv = jnp.pad(rowids, (0, cpad_r), constant_values=0)
+        aa = jnp.pad(jnp.asarray(row_mask), (0, cpad_r),
+                     constant_values=False)
+        x = dv.DistSpVec(vv.reshape(grid.pc, tile_nr),
+                         aa.reshape(grid.pc, tile_nr), grid, COL_AXIS, nr)
+        y = pspmv.spmsv(_SR_MAX2, at, x)
+        return (np.asarray(y.data.reshape(-1)[:nc]),
+                np.asarray(y.active.reshape(-1)[:nc]))
+
+    while True:
+        # BFS from free rows, alternating unmatched/matched edges
+        frontier = mrow < 0
+        if not frontier.any():
+            break
+        col_parent = np.full(nc, -1, np.int32)
+        visited = np.zeros(nc, bool)
+        free_cols = []
+        while frontier.any():
+            pick, hit = reach_cols(frontier)
+            new = hit & ~visited
+            if not new.any():
+                break
+            col_parent[new] = pick[new]
+            visited |= new
+            fnew = new & (mcol < 0)
+            if fnew.any():
+                free_cols = np.nonzero(fnew)[0]
+                break
+            frontier = np.zeros(nr, bool)
+            frontier[mcol[new]] = True
+        if len(free_cols) == 0:
+            break
+        # flip vertex-disjoint augmenting paths
+        used_rows = np.zeros(nr, bool)
+        augmented = False
+        for t in free_cols:
+            path = []
+            c = t
+            ok = True
+            while True:
+                r = col_parent[c]
+                if r < 0 or used_rows[r]:
+                    ok = False
+                    break
+                path.append((r, c))
+                nxt = mrow[r]
+                if nxt < 0:
+                    break
+                c = nxt
+            if not ok:
+                continue
+            for r, c in path:
+                used_rows[r] = True
+            for r, c in path:
+                mrow[r] = c
+                mcol[c] = r
+            augmented = True
+        if not augmented:
+            break
+    return mrow, mcol
+
+
+def matching_cardinality(mrow) -> int:
+    return int((np.asarray(mrow) >= 0).sum())
+
+
+def verify_matching(adj: np.ndarray, mrow: np.ndarray,
+                    mcol: np.ndarray) -> None:
+    """Spec check: consistency + every matched pair is an edge."""
+    mrow = np.asarray(mrow)
+    mcol = np.asarray(mcol)
+    for r in np.nonzero(mrow >= 0)[0]:
+        assert adj[r, mrow[r]] != 0, f"({r},{mrow[r]}) not an edge"
+        assert mcol[mrow[r]] == r, "mate arrays inconsistent"
+    for c in np.nonzero(mcol >= 0)[0]:
+        assert mrow[mcol[c]] == c, "mate arrays inconsistent"
+
+
+# ---------------------------------------------------------------------------
+# Auction-based approximate weight matching (≅ AWPM,
+# ApproxWeightPerfectMatching.h / auction.cpp)
+# ---------------------------------------------------------------------------
+
+def _minus_price(v, p):
+    return v - p
+
+
+def _col_iota(v, j):
+    return j.astype(jnp.float32)
+
+
+def _col_eq(j, b):
+    return (j == b).astype(jnp.float32)
+
+
+def auction_matching(a: dm.DistSpMat, eps: float = 1e-2,
+                     max_rounds: int = 10000):
+    """Approximate max-weight bipartite matching by the eps-scaling
+    auction algorithm. Returns (mate_row, mate_col, total_weight). The
+    final weight is within n*eps of optimal for feasible (perfectly
+    matchable) problems — the classic auction guarantee the
+    reference's AWPM builds on.
+
+    Per round, every unassigned row computes best and second-best
+    profit (value - price) with distributed row-reductions (the
+    second-best masks out each row's best column via a same-structure
+    value combine), bids best-second+eps on its best column, and each
+    column accepts the highest bid, bumping its price. Epsilon scales
+    down geometrically from ~max-weight (prices persist across scales)
+    so round counts stay O(n log(w/eps)) instead of O(n·w/eps). O(n)
+    bid bookkeeping runs on host; all O(nnz) work is distributed.
+    """
+    nr, nc = a.nrows, a.ncols
+    grid = a.grid
+    a = a.astype(jnp.float32)
+    # static column-index matrix (same structure as a)
+    cm = alg.dim_apply(a, "col", dv.iota(grid, COL_AXIS, nc,
+                                         block=a.tile_n), _col_iota)
+    price = np.zeros(nc, np.float32)
+    mrow = np.full(nr, -1, np.int32)
+    mcol = np.full(nc, -1, np.int32)
+
+    rr, cc, vv = dm.to_global_coo(a)    # host COO for the final tally
+    vmax = float(vv.max()) if len(vv) else 1.0
+
+    def run_scale(e):
+        nonlocal mrow, mcol, price
+        for _ in range(max_rounds):
+            free = mrow < 0
+            if not free.any():
+                return
+            pv = dv.from_global(grid, COL_AXIS, jnp.asarray(price),
+                                block=a.tile_n)
+            net = alg.dim_apply(a, "col", pv, _minus_price)
+            best = alg.reduce(S.MAX, net, "row")
+            # best column id: mask near-best entries, take max col index
+            hitm = alg.combine_vals(
+                alg.dim_apply(net, "row", best, _near_best_f), cm,
+                _pick_col)
+            bestcol = alg.reduce(S.MAX, hitm, "row")
+            # second best: -inf out each row's best column
+            bceq = alg.dim_apply(cm, "row", bestcol, _col_eq)
+            net2 = alg.combine_vals(net, bceq, _mask_best_swapped)
+            second = alg.reduce(S.MAX, net2, "row")
+
+            bv = best.to_global()
+            bcg = bestcol.to_global()
+            bc = np.where(np.isfinite(bcg), bcg, 0).astype(np.int64)
+            sv = second.to_global()
+            bidders = free & np.isfinite(bv)
+            if not bidders.any():
+                return
+            sv = np.where(np.isfinite(sv), sv, bv - e)
+            incr = bv - sv + e
+            order = np.argsort(incr[bidders])    # ascending; later wins
+            rows = np.nonzero(bidders)[0][order]
+            winner = {}
+            for r in rows:
+                winner[int(bc[r])] = (int(r), float(incr[r]))
+            progressed = False
+            for c, (r, inc) in winner.items():
+                old = mcol[c]
+                if old >= 0:
+                    mrow[old] = -1
+                mrow[r] = c
+                mcol[c] = r
+                price[c] += inc
+                progressed = True
+            if not progressed:
+                return
+
+    e = max(eps, vmax / 4.0)
+    while True:
+        mrow[:] = -1                 # prices persist; assignment resets
+        mcol[:] = -1
+        run_scale(e)
+        if e <= eps:
+            break
+        e = max(eps, e / 5.0)
+    # vectorized weight tally: matched pairs appear once in the
+    # deduplicated COO, so mrow[rr] == cc selects exactly them
+    matched = (mrow[rr] == cc) & (mrow[rr] >= 0)
+    w = float(np.asarray(vv)[matched].sum())
+    return mrow, mcol, w
+
+
+def _near_best_f(v, b):
+    return (v >= b - 1e-6).astype(jnp.float32)
+
+
+def _pick_col(hit, j):
+    return jnp.where(hit > 0.5, j, -jnp.inf)
+
+
+def _mask_best_swapped(nv, eqf):
+    return jnp.where(eqf > 0.5, -jnp.inf, nv)

@@ -345,6 +345,17 @@ def col_slice(t: Tile, lo: int, hi: int, cap: int) -> Tile:
     return compact(shifted, keep, cap)
 
 
+def row_slice(t: Tile, lo: int, hi: int, cap: int) -> Tile:
+    """Rows [lo, hi) as a new (hi-lo, ncols) tile (rows shifted;
+    ≅ the row-split half of Dcsc splitting). Sorted order survives the
+    uniform shift, so compaction alone suffices."""
+    keep = t.valid() & (t.rows >= lo) & (t.rows < hi)
+    nrows_new = hi - lo
+    shifted = Tile(jnp.where(keep, t.rows - lo, nrows_new), t.cols,
+                   t.vals, t.nnz, nrows_new, t.ncols)
+    return compact(shifted, keep, cap)
+
+
 def col_concat(tiles: list, cap: int) -> Tile:
     """Concatenate tiles horizontally (inverse of `col_slice` splits).
 

@@ -1,6 +1,10 @@
-"""Distributed layer: process-grid mesh, distributed sparse matrices and
-vectors, and the collective algorithms (SpMV, SUMMA SpGEMM) over them."""
+"""Distributed layer: 2D/3D process-grid meshes, distributed sparse
+matrices/vectors/dense objects, and the collective algorithms over
+them — SpMV/SpMSpV/SpMM, streaming & phased SUMMA SpGEMM, the matrix
+algebra surface (Reduce/Apply/Prune/Kselect/DimApply/EWise), and
+general indexing/assignment."""
 
 from combblas_tpu.parallel.grid import ProcGrid
 from combblas_tpu.parallel.distmat import DistSpMat
 from combblas_tpu.parallel.distvec import DistVec, DistSpVec
+from combblas_tpu.parallel.densemat import DistDense, DistMultiVec

@@ -135,6 +135,29 @@ def remove_loops(a: DistSpMat) -> DistSpMat:
     return prune_i(a, _is_diag)
 
 
+@jax.jit
+def prune_cross(a: DistSpMat, rmask: Array, cmask: Array) -> DistSpMat:
+    """Drop entries in the row-set x column-set cross product given by
+    boolean (nrows,)/(ncols,) masks — the traced-operand variant of
+    PruneI for membership predicates (masks are data, not jit
+    constants, so repeated calls reuse one compilation)."""
+    pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
+    ti = jnp.repeat(jnp.arange(pr, dtype=jnp.int32), pc) * a.tile_m
+    tj = jnp.tile(jnp.arange(pc, dtype=jnp.int32), pr) * a.tile_n
+
+    def one(rows, cols, vals, nnz, ro, co):
+        t = tl.Tile(rows, cols, vals, nnz, a.tile_m, a.tile_n)
+        gi = jnp.clip(rows + ro, 0, rmask.shape[0] - 1)
+        gj = jnp.clip(cols + co, 0, cmask.shape[0] - 1)
+        keep = t.valid() & ~(rmask[gi] & cmask[gj])
+        return ta.compact(t, keep)
+
+    out = jax.vmap(one)(
+        a.rows.reshape(-1, cap), a.cols.reshape(-1, cap),
+        a.vals.reshape(-1, cap), a.nnz.reshape(-1), ti, tj)
+    return _rewrap(a, out)
+
+
 @partial(jax.jit, static_argnames=("pred", "cap"))
 def prune_column(a: DistSpMat, thresh: DistVec, pred,
                  cap: Optional[int] = None) -> DistSpMat:
@@ -267,6 +290,19 @@ def _sel_first(x, y):
     return x
 
 
+@partial(jax.jit, static_argnames=("fn",))
+def combine_vals(a: DistSpMat, b: DistSpMat, fn) -> DistSpMat:
+    """Entrywise value combine of two matrices with IDENTICAL sparsity
+    structure (same tiles, same entry order) — the zero-cost EWise for
+    the common derived-matrix case (both operands produced from the
+    same source by value-only ops like apply/dim_apply). Structure
+    identity is the caller's contract; only shapes are checked."""
+    _check_same_grid(a, b)
+    if a.rows.shape != b.rows.shape:
+        raise ValueError("combine_vals needs identical capacities")
+    return dataclasses.replace(a, vals=fn(a.vals, b.vals))
+
+
 def set_difference(a: DistSpMat, b: DistSpMat,
                    cap: Optional[int] = None) -> DistSpMat:
     """A \\ B on coordinates (≅ SetDifference, ParFriends.h:2157)."""
@@ -274,12 +310,15 @@ def set_difference(a: DistSpMat, b: DistSpMat,
 
 
 @partial(jax.jit, static_argnames=("fn", "allow_a_null", "allow_b_null",
-                                   "cap"))
+                                   "cap", "pass_presence"))
 def ewise_apply(a: DistSpMat, b: DistSpMat, fn, *,
                 allow_a_null: bool = False, allow_b_null: bool = False,
-                a_null=0, b_null=0, cap: Optional[int] = None) -> DistSpMat:
+                a_null=0, b_null=0, cap: Optional[int] = None,
+                pass_presence: bool = False) -> DistSpMat:
     """General union/intersection EWise on aligned grids
-    (≅ EWiseApply, ParFriends.h:2194-2243)."""
+    (≅ EWiseApply, ParFriends.h:2194-2243). With ``pass_presence``,
+    ``fn(va, vb, a_has, b_has)`` sees presence flags (the extended
+    predicate form)."""
     _check_same_grid(a, b)
     ocap = cap if cap is not None else (
         a.cap + b.cap if (allow_a_null or allow_b_null)
@@ -291,7 +330,8 @@ def ewise_apply(a: DistSpMat, b: DistSpMat, fn, *,
         bt = tl.Tile(br, bc, bv, bn, b.tile_m, b.tile_n)
         return ta.ewise_apply(at, bt, fn, allow_a_null=allow_a_null,
                               allow_b_null=allow_b_null, a_null=a_null,
-                              b_null=b_null, cap=ocap)
+                              b_null=b_null, cap=ocap,
+                              pass_presence=pass_presence)
 
     out = jax.vmap(one)(
         a.rows.reshape(pr * pc, -1), a.cols.reshape(pr * pc, -1),

@@ -133,6 +133,17 @@ def from_global_coo(add: Monoid, grid: ProcGrid, rows, cols, vals,
     rows = jnp.asarray(rows, jnp.int32)
     cols = jnp.asarray(cols, jnp.int32)
     vals = jnp.asarray(vals)
+    if rows.shape[0] == 0:
+        # zero-entry input: one out-of-range (dropped) placeholder keeps
+        # every kernel's shape machinery away from 0-length arrays. It
+        # must sit beyond the PADDED dims (pr*tile_m, pc*tile_n) — the
+        # logical (nrows, ncols) corner can fall inside the last tile's
+        # padding and would survive as a phantom entry
+        rows = jnp.full((1,), _ceil_div(nrows, grid.pr) * grid.pr,
+                        jnp.int32)
+        cols = jnp.full((1,), _ceil_div(ncols, grid.pc) * grid.pc,
+                        jnp.int32)
+        vals = jnp.zeros((1,), vals.dtype)
     if cap is None:
         per = _ceil_div(int(rows.shape[0]), grid.pr * grid.pc)
         cap = min(int(rows.shape[0]),

@@ -1,0 +1,306 @@
+"""3D process grid + communication-avoiding SUMMA3D.
+
+Capability parity: `CommGrid3D` (CommGrid3D.h:9 — l layers, each an
+r×c grid, plus the cross-layer "fiber" world), `SpParMat3D` layer-split
+replication (SpParMat3D.h:44), and `Mult_AnXBn_SUMMA3D`
+(ParFriends.h:2919: per-layer 2D SUMMA + fiber reduction/merge).
+
+TPU-native re-design: the third axis is literally a third mesh axis
+("l"). A 3D matrix is the stacked per-layer tile arrays sharded
+P("l","r","c",None): layer k of an A-split matrix holds A's k-th
+inner-dimension column slice (B-split: row slice). SUMMA3D is ONE
+shard_map over all three axes — the per-layer interval-streaming 2D
+SUMMA body (broadcasts ride "r"/"c" only) followed by the fiber merge
+as an all_gather along "l" + k-way concat-merge. Communication per
+device drops by ~l on the SUMMA broadcasts, the 3D grid's raison
+d'être (SISC'16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops import tile_algebra as ta
+from combblas_tpu.ops.semiring import Semiring
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS, LAYER_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcGrid3D:
+    """l×pr×pc device mesh (≅ CommGrid3D: layerWorld = collectives
+    over ("r","c"), fiberWorld = collectives over "l")."""
+
+    mesh: Mesh
+
+    @staticmethod
+    def make(nlayers: int, pr: Optional[int] = None,
+             pc: Optional[int] = None, devices=None) -> "ProcGrid3D":
+        devices = list(devices if devices is not None else jax.devices())
+        p = len(devices)
+        if p % nlayers:
+            raise ValueError(f"{p} devices not divisible by {nlayers} layers")
+        q = p // nlayers
+        if pr is None and pc is None:
+            pr = int(math.isqrt(q))
+            while q % pr:
+                pr -= 1
+            pc = q // pr
+        elif pr is None:
+            pr = q // pc
+        elif pc is None:
+            pc = q // pr
+        if nlayers * pr * pc != p:
+            raise ValueError(f"grid {nlayers}x{pr}x{pc} != {p} devices")
+        arr = np.array(devices).reshape(nlayers, pr, pc)
+        return ProcGrid3D(Mesh(arr, (LAYER_AXIS, ROW_AXIS, COL_AXIS)))
+
+    @property
+    def nlayers(self) -> int:
+        return self.mesh.shape[LAYER_AXIS]
+
+    @property
+    def pr(self) -> int:
+        return self.mesh.shape[ROW_AXIS]
+
+    @property
+    def pc(self) -> int:
+        return self.mesh.shape[COL_AXIS]
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def __hash__(self):
+        return hash((self.mesh.devices.shape,
+                     tuple(d.id for d in self.mesh.devices.flat)))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcGrid3D)
+                and self.mesh.devices.shape == other.mesh.devices.shape
+                and (self.mesh.devices == other.mesh.devices).all())
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DistSpMat3D:
+    """Layer-split 3D matrix (≅ SpParMat3D): layer k holds the k-th
+    inner-dimension slice — split="col": A's columns [k*w,(k+1)*w);
+    split="row": B's rows. Arrays (l, pr, pc, cap), local tile coords
+    within the slice."""
+
+    rows: jax.Array
+    cols: jax.Array
+    vals: jax.Array
+    nnz: jax.Array                  # (l, pr, pc)
+    grid: ProcGrid3D = dataclasses.field(metadata=dict(static=True))
+    nrows: int = dataclasses.field(metadata=dict(static=True))
+    ncols: int = dataclasses.field(metadata=dict(static=True))
+    tile_m: int = dataclasses.field(metadata=dict(static=True))
+    tile_n: int = dataclasses.field(metadata=dict(static=True))
+    split: str = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def cap(self) -> int:
+        return self.rows.shape[-1]
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+
+def _stack_layers(grid3: ProcGrid3D, mats, nrows, ncols, split) -> DistSpMat3D:
+    """Stack per-layer 2D window matrices (host) onto the 3D mesh."""
+    cap = max(m.cap for m in mats)
+    grown = []
+    for m in mats:
+        r = np.asarray(m.rows)
+        c = np.asarray(m.cols)
+        v = np.asarray(m.vals)
+        if m.cap < cap:
+            pad = cap - m.cap
+            r = np.concatenate([r, np.full(r.shape[:2] + (pad,), m.tile_m,
+                                           np.int32)], -1)
+            c = np.concatenate([c, np.full(c.shape[:2] + (pad,), m.tile_n,
+                                           np.int32)], -1)
+            v = np.concatenate([v, np.zeros(v.shape[:2] + (pad,),
+                                            v.dtype)], -1)
+        grown.append((r, c, v, np.asarray(m.nnz)))
+    rows = jnp.asarray(np.stack([g[0] for g in grown]))
+    cols = jnp.asarray(np.stack([g[1] for g in grown]))
+    vals = jnp.asarray(np.stack([g[2] for g in grown]))
+    nnz = jnp.asarray(np.stack([g[3] for g in grown]))
+    sh4 = grid3.sharding(LAYER_AXIS, ROW_AXIS, COL_AXIS, None)
+    sh3 = grid3.sharding(LAYER_AXIS, ROW_AXIS, COL_AXIS)
+    return DistSpMat3D(
+        jax.device_put(rows, sh4), jax.device_put(cols, sh4),
+        jax.device_put(vals, sh4), jax.device_put(nnz, sh3),
+        grid3, nrows, ncols, mats[0].tile_m, mats[0].tile_n, split)
+
+
+def split_to_3d(grid3: ProcGrid3D, a: dm.DistSpMat,
+                split: str) -> DistSpMat3D:
+    """Distribute a 2D matrix's inner-dimension slices over the layers
+    (≅ the SpParMat3D ctor's layer split, SpParMat3D.h:44). split="col"
+    slices columns (for the A operand), "row" slices rows (for B)."""
+    l = grid3.nlayers
+    mats = []
+    if split == "col":
+        w = -(-a.tile_n // l)
+        for k in range(l):
+            mats.append(spg._col_window(a, k * w, w))
+    elif split == "row":
+        w = -(-a.tile_m // l)
+        cap = a.cap
+
+        def one(lo, hi):
+            def body(rows, cols, vals, nnz):
+                t = tl.Tile(rows, cols, vals, nnz, a.tile_m, a.tile_n)
+                return ta.row_slice(t, lo, hi, cap)
+            out = jax.vmap(body)(
+                a.rows.reshape(-1, cap), a.cols.reshape(-1, cap),
+                a.vals.reshape(-1, cap), a.nnz.reshape(-1))
+            wcap = min(cap, max(128, -(-int(np.asarray(out.nnz).max())
+                                       // 128) * 128))
+            pr, pc = a.grid.pr, a.grid.pc
+            return dm.DistSpMat(
+                out.rows[:, :wcap].reshape(pr, pc, wcap),
+                out.cols[:, :wcap].reshape(pr, pc, wcap),
+                out.vals[:, :wcap].reshape(pr, pc, wcap),
+                out.nnz.reshape(pr, pc), a.grid,
+                a.grid.pr * (hi - lo), a.ncols, hi - lo, a.tile_n)
+        for k in range(l):
+            mats.append(one(k * w, min((k + 1) * w, a.tile_m)))
+    else:
+        raise ValueError("split must be 'col' or 'row'")
+    return _stack_layers(grid3, mats, a.nrows, a.ncols, split)
+
+
+def summa3d(sr: Semiring, a3: DistSpMat3D, b3: DistSpMat3D, *,
+            flops_cap: int, out_cap: int):
+    """C = A ⊗ B on the 3D grid (≅ Mult_AnXBn_SUMMA3D,
+    ParFriends.h:2919): per-layer interval-streaming SUMMA over the
+    layer's inner slice, then the fiber merge (all_gather over "l" +
+    concat-merge). Returns stacked (pr, pc) C tile arrays replicated
+    across layers, plus the tile geometry — `gather_3d_result` makes a
+    host matrix for verification."""
+    if a3.grid != b3.grid:
+        raise ValueError("GRIDMISMATCH")
+    if a3.split != "col" or b3.split != "row":
+        raise ValueError("summa3d needs A col-split and B row-split")
+    if a3.grid.pr != a3.grid.pc or a3.tile_n != b3.tile_m:
+        # local layer windows of the two operands must select the SAME
+        # global inner coordinates; that alignment holds exactly on
+        # square layer grids with matched tiling (the reference's 3D
+        # grids are always square-layered too, CommGrid3D.h:21-76)
+        raise ValueError("summa3d needs a square layer grid with "
+                         "matched operand tiling (pr == pc, "
+                         "A.tile_n == B.tile_m)")
+    grid3 = a3.grid
+    l = grid3.nlayers
+    mesh = grid3.mesh
+    tile_m, tile_nb = a3.tile_m, b3.tile_n
+    stage_cap = min(flops_cap, out_cap)
+    out_dtype = jax.eval_shape(
+        sr.multiply, jax.ShapeDtypeStruct((), a3.dtype),
+        jax.ShapeDtypeStruct((), b3.dtype)).dtype
+
+    # per-layer slice geometry: A slice is (nrows x w_a) per tile,
+    # B slice (w_b x ncols); intervals from overlaying those tilings
+    inner_a = grid3.pc * a3.tile_n
+    inner_b = grid3.pr * b3.tile_m
+    inner = min(inner_a, inner_b)
+    bounds = sorted({min(k * a3.tile_n, inner) for k in range(grid3.pc + 1)}
+                    | {min(k * b3.tile_m, inner)
+                       for k in range(grid3.pr + 1)})
+    intervals = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if hi > lo:
+            ja, ib = lo // a3.tile_n, lo // b3.tile_m
+            intervals.append((lo, hi, ja, lo - ja * a3.tile_n,
+                              ib, lo - ib * b3.tile_m))
+
+    def f(ar, ac, av, an, br, bc, bv, bn):
+        my_r = lax.axis_index(ROW_AXIS)
+        my_c = lax.axis_index(COL_AXIS)
+        ar, ac, av, an = (x[0, 0, 0] for x in (ar, ac, av, an))
+        br, bc, bv, bn = (x[0, 0, 0] for x in (br, bc, bv, bn))
+        acc = tl.empty(tile_m, tile_nb, out_cap, out_dtype)
+        at = bt = None
+        prev_ja = prev_ib = None
+        for (lo, hi, ja, la, ib, lb) in intervals:
+            if ja != prev_ja:
+                at = spg._bcast_tile(ar, ac, av, an, my_c == ja, COL_AXIS,
+                                     a3.tile_m, a3.tile_n)
+                prev_ja = ja
+            if ib != prev_ib:
+                bt = spg._bcast_tile(br, bc, bv, bn, my_r == ib, ROW_AXIS,
+                                     b3.tile_m, b3.tile_n)
+                prev_ib = ib
+            part = tl.spgemm_ranged(sr, at, bt, a_lo=la, b_lo=lb,
+                                    length=hi - lo, flops_cap=flops_cap,
+                                    out_cap=stage_cap)
+            acc = tl.concat_merge(sr.add, [acc, part], cap=out_cap)
+        # fiber merge (≅ the Alltoall+MultiwayMergeHash along fiberWorld)
+        gr = lax.all_gather(acc.rows, LAYER_AXIS)
+        gc = lax.all_gather(acc.cols, LAYER_AXIS)
+        gv = lax.all_gather(acc.vals, LAYER_AXIS)
+        gn = lax.all_gather(acc.nnz, LAYER_AXIS)
+        layers = [tl.Tile(gr[k], gc[k], gv[k], gn[k], tile_m, tile_nb)
+                  for k in range(l)]
+        c = tl.concat_merge(sr.add, layers, cap=out_cap)
+        return (c.rows[None, None, None], c.cols[None, None, None],
+                c.vals[None, None, None], c.nnz[None, None, None])
+
+    spec4 = P(LAYER_AXIS, ROW_AXIS, COL_AXIS, None)
+    spec3 = P(LAYER_AXIS, ROW_AXIS, COL_AXIS)
+    cr, cc, cv, cn = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(spec4,) * 3 + (spec3,) + (spec4,) * 3 + (spec3,),
+        out_specs=(spec4,) * 3 + (spec3,),
+        check_vma=False,
+    )(a3.rows, a3.cols, a3.vals, a3.nnz, b3.rows, b3.cols, b3.vals, b3.nnz)
+    return cr, cc, cv, cn, tile_m, tile_nb
+
+
+def spgemm_3d(sr: Semiring, grid3: ProcGrid3D, a: dm.DistSpMat,
+              b: dm.DistSpMat, cap_round: int = 4096) -> np.ndarray:
+    """Host-verifiable end-to-end 3D multiply: split 2D operands onto
+    the layers, run summa3d, and gather C as host COO-dense (the
+    SpGEMM3DTest pattern: 3D result compared against 2D)."""
+    a3 = split_to_3d(grid3, a, "col")
+    b3 = split_to_3d(grid3, b, "row")
+    # plan: per-layer flops are a subset of the 2D plan's; reuse it
+    fc, oc = spg.plan_spgemm(a, b)
+    fc = -(-fc // cap_round) * cap_round
+    oc = -(-oc // cap_round) * cap_round
+    cr, cc, cv, cn, tm, tn = summa3d(sr, a3, b3, flops_cap=fc, out_cap=oc)
+    return gather_3d_result(cr, cc, cv, cn, tm, tn, a.nrows, b.ncols,
+                            grid3)
+
+
+def gather_3d_result(cr, cc, cv, cn, tile_m, tile_n, nrows, ncols,
+                     grid3: ProcGrid3D) -> np.ndarray:
+    """Layer-0 C tiles -> host dense (verification aid)."""
+    r = np.asarray(cr)[0]
+    c = np.asarray(cc)[0]
+    v = np.asarray(cv)[0]
+    n = np.asarray(cn)[0]
+    out = np.zeros((grid3.pr * tile_m, grid3.pc * tile_n),
+                   np.asarray(v).dtype)
+    for i in range(grid3.pr):
+        for j in range(grid3.pc):
+            k = n[i, j]
+            out[i * tile_m + r[i, j, :k], j * tile_n + c[i, j, :k]] = \
+                v[i, j, :k]
+    return out[:nrows, :ncols]

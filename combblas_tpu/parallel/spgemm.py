@@ -194,11 +194,19 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         ar, ac, av, an = ar[0, 0], ac[0, 0], av[0, 0], an[0, 0]
         br, bc, bv, bn = br[0, 0], bc[0, 0], bv[0, 0], bn[0, 0]
         acc = tl.empty(tile_m, tile_nb, out_cap, out_dtype)
+        at = bt = None
+        prev_ja = prev_ib = None
         for (lo, hi, ja, la, ib, lb) in intervals:
-            at = _bcast_tile(ar, ac, av, an, my_c == ja, COL_AXIS,
-                             a.tile_m, a.tile_n)
-            bt = _bcast_tile(br, bc, bv, bn, my_r == ib, ROW_AXIS,
-                             b.tile_m, b.tile_n)
+            # consecutive intervals often share one operand tile (a cut
+            # from only the other tiling); re-broadcast only on change
+            if ja != prev_ja:
+                at = _bcast_tile(ar, ac, av, an, my_c == ja, COL_AXIS,
+                                 a.tile_m, a.tile_n)
+                prev_ja = ja
+            if ib != prev_ib:
+                bt = _bcast_tile(br, bc, bv, bn, my_r == ib, ROW_AXIS,
+                                 b.tile_m, b.tile_n)
+                prev_ib = ib
             part = tl.spgemm_ranged(sr, at, bt, a_lo=la, b_lo=lb,
                                     length=hi - lo, flops_cap=flops_cap,
                                     out_cap=stage_cap)

@@ -1,0 +1,7 @@
+"""Auxiliary subsystems: timing/profiling phase taxonomy and typed
+configuration (SURVEY §5 parity)."""
+
+from combblas_tpu.utils.timing import Timers, trace, PHASES
+from combblas_tpu.utils.config import (
+    BfsConfig, SpGemmBenchConfig, parse_cli,
+)

@@ -1,0 +1,66 @@
+"""Typed configuration for the applications (the flag system).
+
+Capability parity: the reference's three config levels (SURVEY §5) —
+compile-time macros, per-app hand-rolled argv parsing (MCL's
+`ProcessParam`, MCL.cpp:233-296, is the richest), and environment
+variables. Here: frozen dataclasses per app + one generic
+dataclass->argparse bridge (`parse_cli`), so every knob is typed,
+defaulted, and discoverable (`--help`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Optional, Type, TypeVar
+
+from combblas_tpu.models.mcl import MclParams
+
+T = TypeVar("T")
+
+
+@dataclasses.dataclass
+class BfsConfig:
+    """Graph500 BFS harness knobs (≅ TopDownBFS/DirOptBFS argv)."""
+    scale: int = 22
+    edgefactor: int = 16
+    nroots: int = 64
+    seed: int = 1
+    alpha: int = 8                  # direction-switch threshold
+    validate_roots: int = 1         # spec-validate this many roots
+    verbose: bool = False
+
+
+@dataclasses.dataclass
+class SpGemmBenchConfig:
+    """A*A benchmark knobs (≅ the SpGEMM driver CLIs)."""
+    scale: int = 16
+    edgefactor: int = 16
+    phase_flop_budget: int = 2 ** 27
+    seed: int = 1
+
+
+def parse_cli(cls: Type[T], argv: Optional[list] = None,
+              prog: Optional[str] = None) -> T:
+    """Build an argparse CLI from a config dataclass: every field
+    becomes `--name` with its default and type (bools become
+    store_true flags). ≅ ProcessParam, generically."""
+    ap = argparse.ArgumentParser(prog=prog or cls.__name__)
+    for f in dataclasses.fields(cls):
+        name = "--" + f.name.replace("_", "-")
+        if f.type in (bool, "bool"):
+            ap.add_argument(name, action="store_true",
+                            default=f.default)
+        else:
+            typ = f.type if callable(f.type) else _resolve(f.type)
+            ap.add_argument(name, type=typ, default=f.default)
+    ns = ap.parse_args(argv)
+    return cls(**{f.name: getattr(ns, f.name)
+                  for f in dataclasses.fields(cls)})
+
+
+def _resolve(t):
+    return {"int": int, "float": float, "str": str}.get(t, str)
+
+
+__all__ = ["BfsConfig", "SpGemmBenchConfig", "MclParams", "parse_cli"]

@@ -1,0 +1,77 @@
+"""Phase-taxonomy timers and profiler hooks.
+
+Capability parity: the reference's TIMING accumulators
+(cblas_alltoalltime / allgathertime / localspmvtime / mergeconttime /
+transvectime, CombBLAS.h:78-100, stamped around each SpMV/SpGEMM phase
+e.g. ParFriends.h:1743-1879) and its Fan-Out/LocalSpMV/Fan-In/Merge
+PAPI phase matrices (papi_combblas_globals.h).
+
+TPU-native re-design: inside one jitted program XLA fuses the phases,
+so wall-clock attribution happens at two levels: (1) host-level named
+accumulators (`Timers`) around eager or per-call stages — the
+MPI_Wtime analogue; (2) `trace()` wraps `jax.profiler` so the XLA
+op-level breakdown (the true fan-out/local/fan-in/merge split of a
+fused step) lands in a TensorBoard-readable trace directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Callable
+
+import jax
+
+#: the reference's phase taxonomy (papi_combblas_globals.h)
+PHASES = ("fan_out", "local", "fan_in", "merge")
+
+
+class Timers:
+    """Named wall-clock accumulators (≅ the cblas_* globals)."""
+
+    def __init__(self):
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def timed(self, name: str, fn: Callable, *args, **kw):
+        """Run fn, blocking on its outputs so device time is included
+        (without block_until_ready a dispatch returns immediately and
+        the phase under-reports)."""
+        with self.phase(name):
+            out = fn(*args, **kw)
+            jax.block_until_ready(out)
+        return out
+
+    def report(self) -> dict:
+        return {k: {"total_s": round(self.totals[k], 6),
+                    "calls": self.counts[k],
+                    "mean_ms": round(1e3 * self.totals[k]
+                                     / max(1, self.counts[k]), 3)}
+                for k in sorted(self.totals)}
+
+    def print_report(self, header: str = "timers"):
+        print(f"== {header} ==")
+        for k, v in self.report().items():
+            print(f"  {k:<24} {v['total_s']:>9.4f}s  x{v['calls']}"
+                  f"  ({v['mean_ms']:.3f} ms/call)")
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace context — the XLA-level phase breakdown
+    (open the logdir with TensorBoard / xprof)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,226 @@
+"""Application-tier tests: MIS, matchings, orderings, BFS variants —
+spec checks + golden comparisons (scipy where available)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.sparse.csgraph as csg
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.models import bfs_variants as bv
+from combblas_tpu.models import matching as mt
+from combblas_tpu.models import mis as mi
+from combblas_tpu.models import ordering as od
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()
+
+
+def _sym_graph(rng, n, p=0.1):
+    d = (rng.random((n, n)) < p)
+    d = d | d.T
+    np.fill_diagonal(d, False)
+    return d
+
+
+class TestMIS:
+    def test_luby_independent_and_maximal(self, rng, grid):
+        n = 48
+        d = _sym_graph(rng, n, 0.15)
+        a = dm.from_dense(S.LOR, grid, d, False)
+        member = np.asarray(mi.mis(a, jax.random.key(0)).to_global())
+        mi.verify_mis(d.astype(int), member)
+
+    def test_empty_graph_all_in(self, grid):
+        n = 10
+        a = dm.from_dense(S.LOR, grid, np.zeros((n, n), bool), False)
+        member = np.asarray(mi.mis(a, jax.random.key(1)).to_global())
+        assert member.all()
+
+    def test_filtered_mis(self, rng, grid):
+        # edges carry weights; only heavy edges constrain the set
+        n = 32
+        w = rng.random((n, n)).astype(np.float32)
+        w = np.triu(w, 1)
+        w = w + w.T
+        w[w < 0.7] = 0              # sparse-ish
+        a = dm.from_dense(S.PLUS, grid, w, 0.0)
+        member = np.asarray(
+            mi.mis(a, jax.random.key(2), pred=_heavy).to_global())
+        conflict = (w > 0.9).astype(int)
+        mi.verify_mis(conflict, member)
+
+
+def _heavy(v):
+    return v > 0.9
+
+
+class TestMaximalMatching:
+    def test_greedy_validity(self, rng, grid):
+        d = rng.random((20, 24)) < 0.2
+        a = dm.from_dense(S.LOR, grid, d, False)
+        mrow, mcol = mt.maximal_matching(a)
+        mt.verify_matching(d.astype(int), np.asarray(mrow),
+                           np.asarray(mcol))
+
+    def test_greedy_is_maximal(self, rng, grid):
+        d = rng.random((16, 16)) < 0.3
+        a = dm.from_dense(S.LOR, grid, d, False)
+        mrow, mcol = (np.asarray(x) for x in mt.maximal_matching(a))
+        # no unmatched row may have an unmatched neighbor
+        for r in np.nonzero(mrow < 0)[0]:
+            nbrs = np.nonzero(d[r])[0]
+            assert (mcol[nbrs] >= 0).all(), f"row {r} could still match"
+
+    def test_karp_sipser_runs(self, rng, grid):
+        d = rng.random((18, 18)) < 0.15
+        a = dm.from_dense(S.LOR, grid, d, False)
+        mrow, mcol = mt.maximal_matching(a, karp_sipser=True)
+        mt.verify_matching(d.astype(int), np.asarray(mrow),
+                           np.asarray(mcol))
+
+
+class TestMaximumMatching:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_cardinality_matches_scipy(self, grid, seed):
+        rng = np.random.default_rng(seed)
+        d = rng.random((22, 25)) < 0.12
+        a = dm.from_dense(S.LOR, grid, d, False)
+        mrow, mcol = mt.maximum_matching(a)
+        mt.verify_matching(d.astype(int), mrow, mcol)
+        exp = sp.csgraph.maximum_bipartite_matching(
+            sp.csr_matrix(d.astype(int)), perm_type="column")
+        assert mt.matching_cardinality(mrow) == int((exp >= 0).sum())
+
+    def test_perfect_on_permutation(self, grid):
+        n = 12
+        perm = np.random.default_rng(3).permutation(n)
+        d = np.zeros((n, n), bool)
+        d[np.arange(n), perm] = True
+        a = dm.from_dense(S.LOR, grid, d, False)
+        mrow, _ = mt.maximum_matching(a)
+        assert mt.matching_cardinality(mrow) == n
+
+
+class TestAuction:
+    def test_near_optimal_weight(self, grid):
+        rng = np.random.default_rng(7)
+        n = 10
+        w = rng.random((n, n)).astype(np.float32) + 0.1   # dense feasible
+        a = dm.from_dense(S.PLUS, grid, w, 0.0)
+        mrow, mcol, got_w = mt.auction_matching(a, eps=1e-3)
+        from scipy.optimize import linear_sum_assignment
+        ri, ci = linear_sum_assignment(-w)
+        opt = float(w[ri, ci].sum())
+        assert mt.matching_cardinality(mrow) == n
+        assert got_w >= opt - n * 1e-3 - 1e-4
+
+
+class TestOrdering:
+    def test_rcm_reduces_bandwidth(self, grid):
+        rng = np.random.default_rng(2)
+        # random ring + chords: natural order has terrible bandwidth
+        n = 40
+        d = np.zeros((n, n), bool)
+        perm = rng.permutation(n)
+        for i in range(n):
+            d[perm[i], perm[(i + 1) % n]] = True
+        d = d | d.T
+        a = dm.from_dense(S.LOR, grid, d, False)
+        p = od.rcm(a)
+        assert sorted(p.tolist()) == list(range(n))   # valid permutation
+        bw0 = od.bandwidth(d)
+        bw1 = od.bandwidth(d[np.ix_(p, p)])
+        assert bw1 < bw0
+        assert bw1 <= 3            # a ring reorders to bandwidth <= 2ish
+
+    def test_rcm_handles_components(self, rng, grid):
+        d = np.zeros((14, 14), bool)
+        d[0, 1] = d[1, 0] = True
+        d[5, 6] = d[6, 5] = True
+        a = dm.from_dense(S.LOR, grid, d, False)
+        p = od.rcm(a)
+        assert sorted(p.tolist()) == list(range(14))
+
+    def test_md_star_eliminates_leaves_first(self, grid):
+        n = 9
+        d = np.zeros((n, n), bool)
+        d[0, 1:] = True
+        d[1:, 0] = True
+        a = dm.from_dense(S.LOR, grid, d, False)
+        order = od.minimum_degree(a)
+        # the hub (degree n-1) outlives all but possibly one leaf (the
+        # final two vertices tie at degree 1)
+        assert np.nonzero(order == 0)[0][0] >= n - 2
+        assert sorted(order.tolist()) == list(range(n))
+
+
+class TestBfsVariants:
+    @pytest.mark.parametrize("policy", ["max", "min"])
+    def test_policies_valid_tree(self, rng, grid, policy):
+        n = 48
+        d = _sym_graph(rng, n, 0.1)
+        a = dm.from_dense(S.LOR, grid, d, False)
+        parents = np.asarray(
+            bv.bfs_select(a, jnp.int32(0), policy=policy).to_global())
+        _check_tree(d, parents, 0)
+
+    def test_random_parent_valid_tree(self, rng, grid):
+        n = 48
+        d = _sym_graph(rng, n, 0.1)
+        a = dm.from_dense(S.LOR, grid, d, False)
+        parents = np.asarray(bv.bfs_select(
+            a, jnp.int32(0), policy="random",
+            key=jax.random.key(5)).to_global())
+        _check_tree(d, parents, 0)
+
+    def test_levels_match_scipy(self, rng, grid):
+        n = 60
+        d = _sym_graph(rng, n, 0.08)
+        a = dm.from_dense(S.LOR, grid, d, False)
+        lv = np.asarray(bv.bfs_levels(a, jnp.int32(3)).to_global())
+        exp = csg.shortest_path(sp.csr_matrix(d.astype(float)),
+                                unweighted=True, indices=3)
+        exp = np.where(np.isinf(exp), -1, exp).astype(np.int64)
+        np.testing.assert_array_equal(lv, exp)
+
+    def test_filtered_bfs_respects_predicate(self, rng, grid):
+        n = 40
+        w = rng.random((n, n)).astype(np.float32)
+        w = np.triu(w, 1)
+        w = w + w.T
+        w[w < 0.5] = 0
+        a = dm.from_dense(S.PLUS, grid, w, 0.0)
+        parents = np.asarray(bv.bfs_select(
+            a, jnp.int32(0), policy="max", pred=_heavy_edge).to_global())
+        allowed = w > 0.8
+        reached = parents >= 0
+        exp = csg.shortest_path(sp.csr_matrix(allowed.astype(float)),
+                                unweighted=True, indices=0)
+        np.testing.assert_array_equal(reached, np.isfinite(exp))
+        _check_tree(allowed, parents, 0)
+
+
+def _heavy_edge(v):
+    return v > 0.8
+
+
+def _check_tree(adj, parents, root):
+    n = adj.shape[0]
+    assert parents[root] == root
+    reached = parents >= 0
+    for v in np.nonzero(reached)[0]:
+        if v == root:
+            continue
+        p = parents[v]
+        assert adj[p, v] or adj[v, p], f"({p},{v}) not an edge"
+    # reached set == root's component
+    ncomp, labels = csg.connected_components(
+        sp.csr_matrix(adj.astype(int)), directed=False)
+    np.testing.assert_array_equal(reached, labels == labels[root])

@@ -1,0 +1,74 @@
+"""3D grid + SUMMA3D: 3D result must equal the 2D product
+(≅ ReleaseTests/SpGEMM3DTest.cpp's 3D-vs-2D consistency check)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import grid3d as g3
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid2():
+    # the 2x2 layer grid (matrices distributed here first)
+    return ProcGrid.make(2, 2, jax.devices()[:4])
+
+
+@pytest.fixture(scope="module")
+def grid3():
+    # 2 layers x 2x2 over all 8 virtual devices
+    return g3.ProcGrid3D.make(2, 2, 2)
+
+
+def _sparse(rng, m, n, density=0.3):
+    d = rng.random((m, n)).astype(np.float32)
+    d[rng.random((m, n)) > density] = 0
+    return d
+
+
+def test_make_shapes(grid3):
+    assert (grid3.nlayers, grid3.pr, grid3.pc) == (2, 2, 2)
+
+
+def test_split_roundtrip_geometry(rng, grid2, grid3):
+    d = _sparse(rng, 16, 16)
+    a = dm.from_dense(S.PLUS, grid2, d, 0.0)
+    a3 = g3.split_to_3d(grid3, a, "col")
+    assert a3.rows.shape[0] == 2            # layers
+    b3 = g3.split_to_3d(grid3, a, "row")
+    assert b3.split == "row"
+    # layer slices hold disjoint halves of the nnz
+    total = int(np.asarray(a3.nnz).sum())
+    assert total == a.getnnz()
+
+
+def test_summa3d_matches_2d(rng, grid2, grid3):
+    n = 16
+    da = _sparse(rng, n, n, 0.4)
+    db = _sparse(rng, n, n, 0.4)
+    a = dm.from_dense(S.PLUS, grid2, da, 0.0)
+    b = dm.from_dense(S.PLUS, grid2, db, 0.0)
+    got = g3.spgemm_3d(S.PLUS_TIMES_F32, grid3, a, b)
+    np.testing.assert_allclose(got, da @ db, rtol=1e-4)
+
+
+def test_summa3d_uneven_dims(rng, grid2, grid3):
+    da = _sparse(rng, 13, 11, 0.4)
+    db = _sparse(rng, 11, 15, 0.4)
+    a = dm.from_dense(S.PLUS, grid2, da, 0.0)
+    b = dm.from_dense(S.PLUS, grid2, db, 0.0)
+    got = g3.spgemm_3d(S.PLUS_TIMES_F32, grid3, a, b)
+    np.testing.assert_allclose(got, da @ db, rtol=1e-4)
+
+
+def test_rejects_mismatched_split(rng, grid2, grid3):
+    d = _sparse(rng, 8, 8)
+    a = dm.from_dense(S.PLUS, grid2, d, 0.0)
+    a3 = g3.split_to_3d(grid3, a, "col")
+    with pytest.raises(ValueError, match="col-split"):
+        g3.summa3d(S.PLUS_TIMES_F32, a3, a3, flops_cap=4096,
+                   out_cap=4096)

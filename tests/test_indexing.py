@@ -1,0 +1,97 @@
+"""SubsRef / SpAsgn golden tests vs numpy fancy indexing
+(≅ ReleaseTests/IndexingTest.cpp, SpAsgnTest.cpp patterns)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import indexing as ix
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()
+
+
+def _sparse(rng, m, n, density=0.3, dtype=np.float32):
+    d = rng.random((m, n)).astype(dtype)
+    d[rng.random((m, n)) > density] = 0
+    return d
+
+
+class TestSubsRef:
+    def test_general_submatrix(self, rng, grid):
+        d = _sparse(rng, 23, 31)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        ri = rng.choice(23, 9, replace=False)
+        ci = rng.choice(31, 13, replace=False)
+        got = ix.subs_ref(a, ri, ci)
+        assert (got.nrows, got.ncols) == (9, 13)
+        np.testing.assert_allclose(dm.to_dense(got, 0.0),
+                                   d[np.ix_(ri, ci)], rtol=1e-5)
+
+    def test_permutation_rows(self, rng, grid):
+        d = _sparse(rng, 16, 16)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        perm = rng.permutation(16)
+        got = ix.subs_ref(a, perm, np.arange(16))
+        np.testing.assert_allclose(dm.to_dense(got, 0.0), d[perm],
+                                   rtol=1e-5)
+
+    def test_repeated_indices(self, rng, grid):
+        d = _sparse(rng, 12, 12)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        ri = np.array([3, 3, 7])
+        ci = np.array([0, 5, 5, 1])
+        got = ix.subs_ref(a, ri, ci)
+        np.testing.assert_allclose(dm.to_dense(got, 0.0),
+                                   d[np.ix_(ri, ci)], rtol=1e-5)
+
+    def test_bool_matrix(self, rng, grid):
+        d = _sparse(rng, 14, 14) != 0
+        a = dm.from_dense(S.LOR, grid, d, False)
+        ri = rng.choice(14, 5, replace=False)
+        ci = rng.choice(14, 6, replace=False)
+        got = ix.subs_ref(a, ri, ci)
+        np.testing.assert_array_equal(dm.to_dense(got, False),
+                                      d[np.ix_(ri, ci)])
+
+
+class TestSpAsgn:
+    def test_assign_block(self, rng, grid):
+        d = _sparse(rng, 20, 24)
+        bsub = _sparse(rng, 6, 7, density=0.5)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        b = dm.from_dense(S.PLUS, grid, bsub, 0.0)
+        ri = rng.choice(20, 6, replace=False)
+        ci = rng.choice(24, 7, replace=False)
+        got = ix.sp_asgn(a, ri, ci, b)
+        exp = d.copy()
+        exp[np.ix_(ri, ci)] = bsub
+        np.testing.assert_allclose(dm.to_dense(got, 0.0), exp, rtol=1e-5)
+
+    def test_assign_clears_old_entries(self, rng, grid):
+        d = np.zeros((10, 10), np.float32)
+        d[2, 3] = 5.0
+        d[2, 4] = 6.0
+        d[0, 0] = 1.0
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        empty = dm.from_dense(S.PLUS, grid, np.zeros((2, 2), np.float32),
+                              0.0)
+        got = ix.sp_asgn(a, [2, 5], [3, 4], empty)
+        exp = d.copy()
+        exp[np.ix_([2, 5], [3, 4])] = 0.0
+        np.testing.assert_allclose(dm.to_dense(got, 0.0), exp, rtol=1e-5)
+        assert got.getnnz() == 1   # only d[0,0] survives
+
+    def test_roundtrip_extract_assign(self, rng, grid):
+        d = _sparse(rng, 18, 18)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        ri = rng.choice(18, 5, replace=False)
+        ci = rng.choice(18, 5, replace=False)
+        sub = ix.subs_ref(a, ri, ci)
+        back = ix.sp_asgn(a, ri, ci, sub)     # assign what's there: no-op
+        np.testing.assert_allclose(dm.to_dense(back, 0.0), d, rtol=1e-5)

@@ -1,0 +1,145 @@
+"""I/O tests: Matrix Market read/write round-trips (native parser +
+Python fallback), symmetric completion, the MultTest-style
+read->multiply->write flow, vector and binary checkpoint round-trips."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu.io import mmio, _native
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()
+
+
+def _write_mm_text(path, text):
+    path.write_text(text)
+    return path
+
+
+GENERAL = """%%MatrixMarket matrix coordinate real general
+% a comment line
+4 5 6
+1 1 1.5
+2 3 -2.0
+3 1 4.25
+4 5 7.0
+1 4 0.5
+4 4 -1.0
+"""
+
+SYMMETRIC = """%%MatrixMarket matrix coordinate real symmetric
+3 3 4
+1 1 2.0
+2 1 3.0
+3 1 4.0
+3 3 5.0
+"""
+
+PATTERN = """%%MatrixMarket matrix coordinate pattern general
+3 4 3
+1 2
+2 3
+3 4
+"""
+
+
+def test_native_parser_builds():
+    assert _native.load() is not None, "native parser failed to build"
+
+
+def test_read_general(tmp_path, grid):
+    p = _write_mm_text(tmp_path / "g.mtx", GENERAL)
+    a = mmio.read_mm(S.PLUS, grid, p)
+    assert (a.nrows, a.ncols) == (4, 5)
+    exp = np.zeros((4, 5), np.float32)
+    for (r, c, v) in [(0, 0, 1.5), (1, 2, -2.0), (2, 0, 4.25),
+                      (3, 4, 7.0), (0, 3, 0.5), (3, 3, -1.0)]:
+        exp[r, c] = v
+    np.testing.assert_allclose(dm.to_dense(a, 0.0), exp)
+
+
+def test_read_symmetric_completion(tmp_path, grid):
+    p = _write_mm_text(tmp_path / "s.mtx", SYMMETRIC)
+    a = mmio.read_mm(S.PLUS, grid, p)
+    d = dm.to_dense(a, 0.0)
+    np.testing.assert_allclose(d, d.T)
+    assert d[1, 0] == 3.0 and d[0, 1] == 3.0
+    assert a.getnnz() == 6  # 4 declared + 2 mirrored off-diagonals
+
+
+def test_read_pattern(tmp_path, grid):
+    p = _write_mm_text(tmp_path / "p.mtx", PATTERN)
+    a = mmio.read_mm(S.PLUS, grid, p)
+    d = dm.to_dense(a, 0.0)
+    assert d[0, 1] == 1.0 and d[1, 2] == 1.0 and d[2, 3] == 1.0
+    assert a.getnnz() == 3
+
+
+def test_python_fallback_matches_native(tmp_path, grid, monkeypatch):
+    p = _write_mm_text(tmp_path / "g.mtx", GENERAL)
+    r1, c1, v1, h1 = mmio.read_mm_coo(p)
+    monkeypatch.setattr(_native, "_lib", None)
+    monkeypatch.setattr(_native, "_tried", True)
+    r2, c2, v2, h2 = mmio.read_mm_coo(p)
+    np.testing.assert_array_equal(r1, r2)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_allclose(v1, v2)
+
+
+def test_write_read_roundtrip(tmp_path, rng, grid):
+    d = rng.random((13, 17)).astype(np.float32)
+    d[rng.random((13, 17)) > 0.3] = 0
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    p = tmp_path / "rt.mtx"
+    mmio.write_mm(p, a)
+    b = mmio.read_mm(S.PLUS, grid, p)
+    np.testing.assert_allclose(dm.to_dense(b, 0.0), d, rtol=1e-6)
+
+
+def test_multtest_flow(tmp_path, rng, grid):
+    """The MultTest pattern (ReleaseTests/MultTest.cpp:98-160): read A
+    and B from files, C = A*B, compare against the golden product."""
+    da = rng.random((12, 10)).astype(np.float32)
+    da[rng.random((12, 10)) > 0.4] = 0
+    db = rng.random((10, 14)).astype(np.float32)
+    db[rng.random((10, 14)) > 0.4] = 0
+    mmio.write_mm(tmp_path / "A.mtx", dm.from_dense(S.PLUS, grid, da, 0.0))
+    mmio.write_mm(tmp_path / "B.mtx", dm.from_dense(S.PLUS, grid, db, 0.0))
+    a = mmio.read_mm(S.PLUS, grid, tmp_path / "A.mtx")
+    b = mmio.read_mm(S.PLUS, grid, tmp_path / "B.mtx")
+    c = spg.spgemm(S.PLUS_TIMES_F32, a, b)
+    np.testing.assert_allclose(dm.to_dense(c, 0.0), da @ db, rtol=1e-4)
+    mmio.write_mm(tmp_path / "C.mtx", c)
+    c2 = mmio.read_mm(S.PLUS, grid, tmp_path / "C.mtx")
+    np.testing.assert_allclose(dm.to_dense(c2, 0.0), da @ db, rtol=1e-4)
+
+
+def test_vector_roundtrip(tmp_path, rng, grid):
+    vals = rng.random(37).astype(np.float32)
+    v = dv.from_global(grid, ROW_AXIS, jnp.asarray(vals))
+    mmio.write_vec(tmp_path / "v.txt", v)
+    v2 = mmio.read_vec(grid, tmp_path / "v.txt")
+    np.testing.assert_allclose(v2.to_global(), vals, rtol=1e-6)
+
+
+def test_binary_checkpoint_roundtrip(tmp_path, rng, grid):
+    d = rng.random((19, 21)).astype(np.float32)
+    d[rng.random((19, 21)) > 0.3] = 0
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    mmio.save_matrix(tmp_path / "ckpt.npz", a)
+    b = mmio.load_matrix(S.PLUS, grid, tmp_path / "ckpt.npz")
+    np.testing.assert_allclose(dm.to_dense(b, 0.0), d, rtol=1e-6)
+    # vector checkpoint
+    vv = rng.random(23).astype(np.float32)
+    v = dv.from_global(grid, ROW_AXIS, jnp.asarray(vv))
+    mmio.save_vector(tmp_path / "vec.npz", v)
+    v2 = mmio.load_vector(grid, tmp_path / "vec.npz")
+    np.testing.assert_allclose(v2.to_global(), vv, rtol=1e-6)

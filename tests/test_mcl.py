@@ -63,7 +63,6 @@ def test_inflate_sharpens(rng, grid):
     m0 = dm.to_dense(a, 0.0).max(0)
     m1 = dm.to_dense(infl, 0.0).max(0)
     assert (m1 >= m0 - 1e-6).all()
-    assert M.chaos(infl) <= M.chaos(a) + 1e-6 or True  # sanity only
 
 
 def test_prune_select_recover_caps_columns(rng, grid):

@@ -76,6 +76,16 @@ class TestDistMat:
         np.testing.assert_array_equal(DM.to_dense(a, 0.0), expect)
         assert a.getnnz() == np.count_nonzero(expect)
 
+    def test_empty_input_no_phantom_entry(self, grid24):
+        # regression: the zero-entry placeholder must not survive in
+        # the last tile's padding when dims don't divide the grid
+        a = DM.from_global_coo(S.PLUS, grid24, np.array([], np.int32),
+                               np.array([], np.int32),
+                               jnp.zeros((0,), jnp.float32), 9, 9)
+        assert a.getnnz() == 0
+        np.testing.assert_array_equal(DM.to_dense(a, 0.0),
+                                      np.zeros((9, 9), np.float32))
+
     def test_dedup_on_build(self, grid24):
         rows = np.array([0, 0, 5], np.int32)
         cols = np.array([1, 1, 5], np.int32)
