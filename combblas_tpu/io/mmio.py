@@ -167,6 +167,54 @@ def write_mm(path, a: dm.DistSpMat, pattern: bool = False) -> None:
                 f.write(f"{r + 1} {c + 1} {v:.17g}\n")
 
 
+def read_labeled_tuples(add: Monoid, grid: ProcGrid, path,
+                        dtype=jnp.float32):
+    """String-labeled edge list -> (matrix, labels) (≅
+    ReadGeneralizedTuples, SpParMat.cpp:3824: labels hashed to
+    contiguous vertex ids; the returned list maps id -> label, the
+    FullyDistVec<char[]> of the reference). Lines: "src dst [weight]";
+    '#'/'%' comments skipped."""
+    ids: dict = {}
+    labels: list = []
+    rows, cols, vals = [], [], []
+
+    def intern(lbl):
+        i = ids.get(lbl)
+        if i is None:
+            i = len(labels)
+            ids[lbl] = i
+            labels.append(lbl)
+        return i
+
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if not parts or parts[0][0] in "#%":
+                continue
+            rows.append(intern(parts[0]))
+            cols.append(intern(parts[1]))
+            vals.append(float(parts[2]) if len(parts) > 2 else 1.0)
+    n = len(labels)
+    a = dm.from_global_coo(
+        add, grid, np.asarray(rows, np.int32), np.asarray(cols, np.int32),
+        jnp.asarray(np.asarray(vals).astype(dtype)), n, n)
+    return a, labels
+
+
+def convert_mm_to_binary(src, dst, add: Monoid = PLUS,
+                         grid: Optional[ProcGrid] = None) -> None:
+    """.mtx -> binary checkpoint (≅ binaryconvert/ CLI tools)."""
+    grid = grid or ProcGrid.make()
+    save_matrix(dst, read_mm(add, grid, src))
+
+
+def convert_binary_to_mm(src, dst, add: Monoid = PLUS,
+                         grid: Optional[ProcGrid] = None) -> None:
+    """binary checkpoint -> .mtx."""
+    grid = grid or ProcGrid.make()
+    write_mm(dst, load_matrix(add, grid, src))
+
+
 # ---------------------------------------------------------------------------
 # Vector I/O (≅ FullyDistSpVec::ParallelRead/Write, :1209/1310)
 # ---------------------------------------------------------------------------

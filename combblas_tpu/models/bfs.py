@@ -154,9 +154,7 @@ def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
             "plan was built for a different matrix (plan_bfs(a) rebuilds)")
     n = a.nrows
     grid = a.grid
-    mesh = grid.mesh
-    tile_m, tile_n, cap = a.tile_m, a.tile_n, a.cap
-    tiers = _caps(a)
+    tile_m, tile_n = a.tile_m, a.tile_n
     root = jnp.asarray(root, jnp.int32)
     nnz_total = jnp.sum(a.nnz).astype(jnp.float32)
 
@@ -164,6 +162,26 @@ def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
     parents0 = parents0.at[root // tile_m, root % tile_m].set(root)
     act0 = jnp.zeros((grid.pc, tile_n), bool)
     act0 = act0.at[root // tile_n, root % tile_n].set(True)
+
+    tiers, branches = build_steppers(a, plan)
+    return _bfs_loop(plan, grid, tile_n, tiers, branches,
+                     parents0, act0, nnz_total, alpha, n)
+
+
+def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
+    """(tiers, steppers): the (E, F) budget list and the level
+    steppers built to those budgets — smallest sparse tier first,
+    dense full-scan last. Each stepper is a jitted ``act -> y``
+    callable (act: (pc, tile_n) c-aligned frontier mask; y:
+    (pr, tile_m) r-aligned parent candidates, _IDENT where none).
+    Returned together so the switch's fit check and the compiled
+    budgets can never desynchronize; exposed so tests can force every
+    branch on one frontier and cross-check (the reference's
+    SpMSpV-variant consistency checks, SpMSpVBench.cpp:531-539)."""
+    grid = a.grid
+    mesh = grid.mesh
+    tile_m, tile_n, cap = a.tile_m, a.tile_n, a.cap
+    tiers = _caps(a)
 
     spec3 = P(ROW_AXIS, COL_AXIS, None)
     spec_act = P(COL_AXIS, None)
@@ -254,8 +272,15 @@ def bfs(a: dm.DistSpMat, root, plan: BfsPlan | None = None,
             )(plan.crows, plan.ccols, plan.cstarts, act)
         return sparse_step
 
-    branches = [make_sparse_step(ec, fc) for ec, fc in tiers] + [dense_step]
+    # jitted so standalone calls (cross-check tests, the SpMSpV bench
+    # driver) compile once instead of retracing per call; inside the
+    # jitted BFS while_loop the wrapper is transparent
+    return tiers, ([jax.jit(make_sparse_step(ec, fc)) for ec, fc in tiers]
+                   + [jax.jit(dense_step)])
 
+
+def _bfs_loop(plan, grid, tile_n, tiers, branches, parents0,
+              act0, nnz_total, alpha, n):
     def cond(carry):
         _, _, cont = carry
         return cont

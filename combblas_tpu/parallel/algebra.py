@@ -211,8 +211,50 @@ def dim_apply(a: DistSpMat, dim: str, vec: DistVec, fn) -> DistSpMat:
     return dataclasses.replace(a, vals=vals)
 
 
+@partial(jax.jit, static_argnames=("monoid", "dim", "map_val"))
+def masked_reduce(monoid: Monoid, a: DistSpMat, dim: str, mask: DistVec,
+                  map_val: Callable = None) -> DistVec:
+    """Reduce including only entries whose perpendicular coordinate is
+    selected by ``mask`` (≅ MaskedReduce, SpParMat.h:142: e.g.
+    dim="col" with an r-aligned row mask reduces each column over the
+    masked rows). Unselected entries contribute the identity."""
+    perp = ROW_AXIS if dim == "col" else COL_AXIS
+    if mask.axis != perp:
+        raise ValueError(f"masked_reduce(dim={dim!r}) needs a "
+                         f"{perp!r}-aligned mask")
+    mesh = a.grid.mesh
+
+    def f(rows, cols, vals, nnz, mk):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        coord = t.rows if dim == "col" else t.cols
+        lim = (a.tile_m if dim == "col" else a.tile_n) - 1
+        sel = mk[0][jnp.clip(coord, 0, lim)]
+        # map BEFORE masking: excluded entries must contribute the
+        # identity, not map_val(identity) (the reference applies its
+        # __unary_op only to included entries)
+        vv = map_val(t.vals) if map_val is not None else t.vals
+        ident = monoid.identity(vv.dtype)
+        masked = dataclasses.replace(
+            t, vals=jnp.where(sel, vv, ident))
+        local = ta.reduce(monoid, masked, dim)
+        axis = COL_AXIS if dim == "row" else ROW_AXIS
+        return monoid.axis_reduce(local, axis)[None]
+
+    out_axis = ROW_AXIS if dim == "row" else COL_AXIS
+    data = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 3
+                 + (P(ROW_AXIS, COL_AXIS), P(perp, None)),
+        out_specs=P(out_axis, None),
+    )(a.rows, a.cols, a.vals, a.nnz, mask.data)
+    glen = a.nrows if dim == "row" else a.ncols
+    return DistVec(data, a.grid, out_axis, glen)
+
+
 # ---------------------------------------------------------------------------
-# Kselect (≅ Kselect1, SpParMat.cpp:1191)
+# Kselect (≅ Kselect1 per column, SpParMat.cpp:1191; Kselect2 per row,
+# SpParMat.cpp:1413)
 # ---------------------------------------------------------------------------
 
 @jax.jit
@@ -250,6 +292,34 @@ def kselect1(a: DistSpMat, k, fill) -> DistVec:
     )(a.cols, a.vals, a.nnz, jnp.asarray(k, jnp.int32),
       jnp.asarray(fill, a.dtype))
     return DistVec(data, a.grid, COL_AXIS, a.ncols)
+
+
+@jax.jit
+def kselect2(a: DistSpMat, k, fill) -> DistVec:
+    """Per-ROW k-th largest value of the global row -> r-aligned
+    (nrows,) vector (≅ Kselect2, SpParMat.cpp:1413); the row-wise twin
+    of `kselect1` (all_gather along the column axis instead)."""
+    mesh = a.grid.mesh
+    cap = a.cap
+
+    def f(rows, vals, nnz, kk, fl):
+        gr = lax.all_gather(rows[0, 0], COL_AXIS).reshape(-1)
+        gv = lax.all_gather(vals[0, 0], COL_AXIS).reshape(-1)
+        gn = lax.all_gather(nnz[0, 0], COL_AXIS)          # (pc,)
+        valid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                 < gn[:, None]).reshape(-1)
+        thr = ta.kselect_cols_raw(gr, gv, valid, a.tile_m, kk, fl)
+        return thr[None]
+
+    data = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 2
+                 + (P(ROW_AXIS, COL_AXIS), P(), P()),
+        out_specs=P(ROW_AXIS, None),
+        check_vma=False,
+    )(a.rows, a.vals, a.nnz, jnp.asarray(k, jnp.int32),
+      jnp.asarray(fill, a.dtype))
+    return DistVec(data, a.grid, ROW_AXIS, a.nrows)
 
 
 # ---------------------------------------------------------------------------

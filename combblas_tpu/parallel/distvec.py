@@ -331,6 +331,21 @@ def uniq(sv: DistSpVec) -> DistSpVec:
         sv, active=_from_flat(sv, keep & act, False))
 
 
+def concatenate(vecs: list) -> DistVec:
+    """Concatenate vectors into one (≅ Concatenate, ParFriends.h:61);
+    result aligned like the first."""
+    if not vecs:
+        raise ValueError("nothing to concatenate")
+    flat = jnp.concatenate([_flat(v) for v in vecs])
+    v0 = vecs[0]
+    glen = int(flat.shape[0])
+    nb = v0.data.shape[0]
+    block = -(-glen // nb)
+    tpl = DistVec(jnp.zeros((nb, block), flat.dtype), v0.grid, v0.axis,
+                  glen)
+    return DistVec(_from_flat(tpl, flat), v0.grid, v0.axis, glen)
+
+
 def sp_sort(sv: DistSpVec):
     """Ascending sort of the active values (≅ FullyDistSpVec::sort,
     FullyDistSpVec.cpp:712). Returns (sorted_vals, perm_index) as

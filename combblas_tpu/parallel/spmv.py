@@ -90,3 +90,36 @@ def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
         out_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None)),
     )(a.rows, a.cols, a.vals, a.nnz, x.data, x.active)
     return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)
+
+
+@jax.jit
+def est_spmsv_nnz(a: DistSpMat, x_active) -> jax.Array:
+    """Estimate (here: exact count of) the output nonzeros of an
+    SpMSpV with frontier mask ``x_active`` ((pc, tile_n) c-aligned) —
+    ≅ EstPerProcessNnzSpMV (ParFriends.h:2810), used to pre-size
+    buffers / pick traversal direction. Runs only the hit-mask half of
+    the kernel."""
+    mesh = a.grid.mesh
+
+    def f(rows, cols, nnz, actb):
+        t = tl.Tile(rows[0, 0], cols[0, 0],
+                    jnp.zeros((rows.shape[-1],), jnp.int32), nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        v = t.valid()
+        cg = jnp.clip(t.cols, 0, t.ncols - 1)
+        act = actb[0][cg] & v
+        starts, seg_ends, nonempty = tl.row_structure(t)
+        from combblas_tpu.ops.semiring import MAX
+        hits = tl.seg_reduce_sorted(MAX, act.astype(jnp.int32), starts,
+                                    seg_ends, nonempty) > 0
+        hits = lax.pmax(hits.astype(jnp.int32), COL_AXIS) > 0
+        return jnp.sum(hits)[None]
+
+    per_row = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 2
+                 + (P(ROW_AXIS, COL_AXIS), P(COL_AXIS, None)),
+        out_specs=P(ROW_AXIS),
+        check_vma=False,
+    )(a.rows, a.cols, a.nnz, x_active)
+    return jnp.sum(per_row)

@@ -61,3 +61,72 @@ class TestBFS:
         stats = B.graph500_run(grid, scale=7, edgefactor=8, nroots=3,
                                validate=True)
         assert len(stats.teps) == 3
+
+
+class TestStepperCrossCheck:
+    """Force every sparse tier and the dense stepper on the SAME
+    frontier and require identical parent candidates — no tier's bugs
+    can hide behind the direction-optimizing switch (≅ the reference's
+    SpMSpV-algorithm cross-checks, SpMSpVBench.cpp:531-539)."""
+
+    def _setup(self, grid, scale=9, ef=4, seed=2):
+        from combblas_tpu.ops import generate
+        n = 1 << scale
+        r, c = generate.rmat_edges(jax.random.key(seed), scale, ef)
+        r, c = generate.symmetrize(r, c)
+        a = DM.from_global_coo(S.LOR, grid, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        plan = B.plan_bfs(a)
+        return a, plan, n
+
+    def _fits(self, a, plan, act, ec, fc):
+        actdeg = np.einsum("ijk,jk->ij", np.asarray(plan.cdeg),
+                           np.asarray(act).astype(np.int64))
+        nact_blk = np.asarray(act).sum(1).max()
+        return actdeg.max() <= ec and nact_blk <= fc
+
+    @pytest.mark.parametrize("frontier", ["single", "level2", "wide"])
+    def test_all_fitting_steppers_agree(self, grid22, frontier):
+        a, plan, n = self._setup(grid22)
+        tiers, steppers = B.build_steppers(a, plan)
+
+        act = np.zeros((a.grid.pc, a.tile_n), bool)
+        rng = np.random.default_rng(0)
+        if frontier == "single":
+            act[0, 5] = True
+        elif frontier == "level2":
+            # a realistic frontier: everything the dense step reaches
+            # from one vertex
+            act[0, 5] = True
+            y0 = np.asarray(steppers[-1](jnp.asarray(act)))
+            fresh = y0 != np.iinfo(np.int32).min
+            flat = np.zeros(a.grid.pc * a.tile_n, bool)
+            flat[:n] = fresh.reshape(-1)[:n]
+            act = flat.reshape(a.grid.pc, a.tile_n)
+        else:
+            flat = rng.random(a.grid.pc * a.tile_n) < 0.05
+            flat[n:] = False
+            act = flat.reshape(a.grid.pc, a.tile_n)
+        actj = jnp.asarray(act)
+
+        dense = np.asarray(steppers[-1](actj))
+        checked = 0
+        for (ec, fc), st in zip(tiers, steppers[:-1]):
+            if self._fits(a, plan, act, ec, fc):
+                got = np.asarray(st(actj))
+                np.testing.assert_array_equal(
+                    got, dense, err_msg=f"tier (E={ec},F={fc}) disagrees "
+                                        f"with dense on {frontier}")
+                checked += 1
+        assert checked >= 1, "no sparse tier fit this frontier; widen caps"
+
+    def test_tier_budgets_sane(self, grid22):
+        # budgets ascend (smallest tier first) and respect the floor;
+        # at toy caps all tiers may clamp to the same floor — the
+        # distinctness only appears at bench scale
+        a, plan, n = self._setup(grid22)
+        tiers = B._caps(a)
+        assert len(tiers) == 3
+        ecs = [ec for ec, _ in tiers]
+        assert ecs == sorted(ecs)
+        assert all(ec >= 1024 for ec in ecs)

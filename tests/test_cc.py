@@ -78,3 +78,28 @@ def test_rmat_vs_scipy(grid):
         exp_ncomp, exp_labels = _scipy_labels(r, c, n)
         assert ncomp == exp_ncomp, f"scale {scale}"
         _assert_same_partition(labels.to_global(), exp_ncomp, exp_labels)
+
+
+def test_lacc_matches_fastsv_and_scipy(grid):
+    for scale, ef in [(8, 4), (10, 2)]:
+        n = 1 << scale
+        r, c = generate.rmat_edges(jax.random.key(100 + scale), scale, ef)
+        r, c = generate.symmetrize(r, c)
+        a = _dist_from_edges(grid, r, c, n)
+        la = cc.lacc(a).to_global()
+        exp_ncomp, exp_labels = _scipy_labels(r, c, n)
+        _assert_same_partition(la, exp_ncomp, exp_labels)
+        # independent cross-check: both algorithms induce one partition
+        fs = cc.fastsv(a).to_global()
+        _assert_same_partition(la, len(np.unique(fs)), fs)
+
+
+def test_lacc_two_triangles(grid):
+    r = np.array([0, 1, 2, 3, 4], np.int32)
+    c = np.array([1, 2, 0, 4, 5], np.int32)
+    rs, cs = np.concatenate([r, c]), np.concatenate([c, r])
+    a = _dist_from_edges(grid, rs, cs, 7)
+    la = cc.lacc(a).to_global()
+    assert la[0] == la[1] == la[2]
+    assert la[3] == la[4] == la[5]
+    assert len({la[0], la[3], la[6]}) == 3

@@ -1,0 +1,162 @@
+"""Parity extras: MaskedReduce, Kselect2, vector Concatenate, SpMSpV
+nnz estimator, SemanticGraph, labeled-tuple reads, binary converters,
+and the Galerkin triple-product pattern (Driver.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.io import mmio
+from combblas_tpu.models import semantic as sg
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import algebra as alg
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel import spmv as pm
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return ProcGrid.make()
+
+
+def _sparse(rng, m, n, density=0.3):
+    d = rng.random((m, n)).astype(np.float32)
+    d[rng.random((m, n)) > density] = 0
+    return d
+
+
+def test_masked_reduce_col(rng, grid):
+    d = _sparse(rng, 20, 16)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    sel = rng.random(20) < 0.5
+    mask = dv.from_global(grid, ROW_AXIS, jnp.asarray(sel), fill=False)
+    got = alg.masked_reduce(S.PLUS, a, "col", mask).to_global()
+    np.testing.assert_allclose(got, (d * sel[:, None]).sum(0), rtol=1e-5)
+
+
+def test_masked_reduce_row(rng, grid):
+    d = _sparse(rng, 14, 22)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    sel = rng.random(22) < 0.5
+    mask = dv.from_global(grid, COL_AXIS, jnp.asarray(sel), fill=False,
+                          block=a.tile_n)
+    got = alg.masked_reduce(S.PLUS, a, "row", mask).to_global()
+    np.testing.assert_allclose(got, (d * sel[None, :]).sum(1), rtol=1e-5)
+
+
+def test_masked_reduce_with_map_val(rng, grid):
+    # regression: excluded entries must contribute the identity, not
+    # map_val(identity) — visible with any map_val(0) != 0
+    d = _sparse(rng, 20, 16)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    sel = rng.random(20) < 0.5
+    mask = dv.from_global(grid, ROW_AXIS, jnp.asarray(sel), fill=False)
+    got = alg.masked_reduce(S.PLUS, a, "col", mask,
+                            map_val=_plus_one).to_global()
+    exp = np.where((d != 0) & sel[:, None], d + 1.0, 0.0).sum(0)
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def _plus_one(v):
+    return v + 1.0
+
+
+def test_kselect2_rowwise(rng, grid):
+    d = _sparse(rng, 18, 24, 0.4)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    k = 3
+    got = alg.kselect2(a, k, fill=-1.0).to_global()
+    for i in range(18):
+        rv = d[i][d[i] != 0]
+        exp = np.sort(rv)[-k] if len(rv) >= k else -1.0
+        assert got[i] == pytest.approx(exp), f"row {i}"
+
+
+def test_concatenate(rng, grid):
+    a = dv.from_global(grid, ROW_AXIS, jnp.arange(10, dtype=jnp.int32))
+    b = dv.from_global(grid, ROW_AXIS,
+                       jnp.arange(100, 107, dtype=jnp.int32))
+    got = dv.concatenate([a, b])
+    assert got.glen == 17
+    np.testing.assert_array_equal(
+        got.to_global(), np.concatenate([np.arange(10),
+                                         np.arange(100, 107)]))
+
+
+def test_est_spmsv_nnz(rng, grid):
+    d = _sparse(rng, 30, 30, 0.15)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    act_flat = rng.random(30) < 0.3
+    pad = grid.pc * a.tile_n - 30
+    act = jnp.asarray(np.pad(act_flat, (0, pad))).reshape(grid.pc,
+                                                          a.tile_n)
+    got = int(pm.est_spmsv_nnz(a, act))
+    exp = int(((d != 0) & act_flat[None, :]).any(1).sum())
+    assert got == exp
+
+
+def test_semantic_graph(rng, grid):
+    n = 24
+    w = rng.random((n, n)).astype(np.float32)
+    w = np.triu(w, 1)
+    w = w + w.T
+    w[w < 0.4] = 0
+    g = sg.SemanticGraph(dm.from_dense(S.PLUS, grid, w, 0.0), _heavy)
+    # materialized filter == on-the-fly traversal reachability
+    mat = g.materialize()
+    np.testing.assert_array_equal(dm.to_dense(mat, 0.0) != 0, w > 0.75)
+    parents = np.asarray(g.bfs(jnp.int32(0)).to_global())
+    import scipy.sparse as sp
+    import scipy.sparse.csgraph as csg
+    exp = csg.shortest_path(sp.csr_matrix((w > 0.75).astype(float)),
+                            unweighted=True, indices=0)
+    np.testing.assert_array_equal(parents >= 0, np.isfinite(exp))
+
+
+def _heavy(v):
+    return v > 0.75
+
+
+def test_read_labeled_tuples(tmp_path, grid):
+    p = tmp_path / "edges.txt"
+    p.write_text("# social graph\n"
+                 "alice bob 2.0\n"
+                 "bob carol\n"
+                 "carol alice 0.5\n")
+    a, labels = mmio.read_labeled_tuples(S.PLUS, grid, p)
+    assert labels == ["alice", "bob", "carol"]
+    d = dm.to_dense(a, 0.0)
+    assert d[0, 1] == 2.0 and d[1, 2] == 1.0 and d[2, 0] == 0.5
+
+
+def test_binary_converters(tmp_path, rng, grid):
+    d = _sparse(rng, 12, 12)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    mmio.write_mm(tmp_path / "a.mtx", a)
+    mmio.convert_mm_to_binary(tmp_path / "a.mtx", tmp_path / "a.npz",
+                              grid=grid)
+    mmio.convert_binary_to_mm(tmp_path / "a.npz", tmp_path / "a2.mtx",
+                              grid=grid)
+    b = mmio.read_mm(S.PLUS, grid, tmp_path / "a2.mtx")
+    np.testing.assert_allclose(dm.to_dense(b, 0.0), d, rtol=1e-6)
+
+
+def test_galerkin_triple_product(rng, grid):
+    """R * A * R^T restriction chain (≅ Driver.cpp's galerkin
+    products) via two SUMMA calls."""
+    n, m = 16, 8
+    da = _sparse(rng, n, n, 0.3)
+    dr = np.zeros((m, n), np.float32)
+    for i in range(m):                      # aggregation restriction
+        dr[i, 2 * i] = dr[i, 2 * i + 1] = 0.5
+    a = dm.from_dense(S.PLUS, grid, da, 0.0)
+    r = dm.from_dense(S.PLUS, grid, dr, 0.0)
+    ra = spg.spgemm(S.PLUS_TIMES_F32, r, a)
+    rt = dm.transpose(r)
+    rar = spg.spgemm(S.PLUS_TIMES_F32, ra, rt)
+    np.testing.assert_allclose(dm.to_dense(rar, 0.0), dr @ da @ dr.T,
+                               rtol=1e-4)
