@@ -181,6 +181,11 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
     grid = a.grid
     mesh = grid.mesh
     tile_m, tile_n, cap = a.tile_m, a.tile_n, a.cap
+    if cap > 2 ** 30:
+        raise ValueError(
+            f"tile cap {cap} > 2^30: the dense stepper packs the "
+            "frontier bit into the low bit of an int32 routing key "
+            "(c2r << 1); shard the matrix over more devices")
     tiers = _caps(a)
 
     spec3 = P(ROW_AXIS, COL_AXIS, None)
@@ -214,9 +219,13 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
             seed_t = tl.to_chunked(seed, fill=0)
             eact_c, _ = tl.seg_scan_core(
                 S.MAX, seed_t, crun_t.reshape(chunk_len, 128))
-            # (2) route bits to row order: sort by the static key
-            _, eact_r = lax.sort(
-                (c2r, eact_c.T.reshape(-1)[:cap]), num_keys=1)
+            # (2) route bits to row order: pack the frontier bit into
+            # the low bit of the (distinct) col->row key and sort ONE
+            # int32 array — half the sort payload of a (key, value)
+            # pair sort. cap <= 2^30 so the shift never overflows.
+            packed = (c2r << 1) | eact_c.T.reshape(-1)[:cap].astype(
+                jnp.int32)
+            eact_r = (lax.sort(packed) & 1).astype(jnp.int8)
             # (3) per-row max-scan of parent candidates
             eb = tl.to_chunked(eact_r, fill=0).reshape(-1)
             e_act = (eb > 0) & valid_t
