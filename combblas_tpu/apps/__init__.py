@@ -1,0 +1,44 @@
+"""Runnable application drivers (≅ the reference's Applications/ CLI
+executables): `python -m combblas_tpu.apps.<name> --help`.
+
+Each driver is a thin main() over the models API with a typed config
+(utils.config), mirroring how the reference's mains wrap the library.
+"""
+
+from __future__ import annotations
+
+
+def load_graph(grid, *, mtx: str = "", scale: int = 10,
+               edgefactor: int = 8, seed: int = 1, add=None,
+               dtype=None, symmetrize: bool = False):
+    """Shared driver-side graph construction: a Matrix Market file or
+    an R-MAT generation, optionally symmetrized (BFS/CC need the
+    undirected orientation; a 'general' mtx is completed A|A^T exactly
+    like the reference mains symmetricize their inputs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm
+
+    add = add if add is not None else S.LOR
+    dtype = dtype if dtype is not None else jnp.bool_
+    if mtx:
+        from combblas_tpu.io import mmio
+        rows, cols, vals, h = mmio.read_mm_coo(mtx)
+        already_sym = h.symmetric or h.skew or h.hermitian
+        if symmetrize and not already_sym:
+            off = rows != cols
+            r0, c0 = rows, cols
+            rows = np.concatenate([r0, c0[off]])
+            cols = np.concatenate([c0, r0[off]])
+            vals = np.concatenate([vals, vals[off]])
+        return dm.from_global_coo(
+            add, grid, rows, cols, jnp.asarray(vals.astype(dtype)),
+            h.nrows, h.ncols)
+    n = 1 << scale
+    r, c = generate.rmat_edges(jax.random.key(seed), scale, edgefactor)
+    if symmetrize:
+        r, c = generate.symmetrize(r, c)
+    return dm.from_global_coo(add, grid, r, c,
+                              jnp.ones_like(r, dtype), n, n)

@@ -1,0 +1,65 @@
+"""Graph500 BFS driver (≅ TopDownBFS.cpp / DirOptBFS.cpp mains):
+generate R-MAT (or read a file), run BFS from random roots, print the
+Graph500 statistics line.
+
+    python -m combblas_tpu.apps.bfs --scale 16 --nroots 8
+    python -m combblas_tpu.apps.bfs --mtx graph.mtx --nroots 4
+"""
+
+import dataclasses
+import json
+
+
+@dataclasses.dataclass
+class Config:
+    scale: int = 16
+    edgefactor: int = 16
+    nroots: int = 8
+    seed: int = 1
+    validate_roots: int = 1
+    mtx: str = ""                   # read this file instead of generating
+    verbose: bool = False
+
+
+def main(argv=None):
+    from combblas_tpu.utils.config import parse_cli
+    cfg = parse_cli(Config, argv, prog="bfs")
+
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu.apps import load_graph
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    grid = ProcGrid.make()
+    if cfg.mtx:
+        # BFS needs the undirected (symmetrized) orientation; a
+        # 'general' file is completed A|A^T like the reference mains
+        a = load_graph(grid, mtx=cfg.mtx, symmetrize=True)
+        plan = B.plan_bfs(a)
+        rng = np.random.default_rng(cfg.seed)
+        roots = rng.choice(a.nrows, cfg.nroots, replace=False)
+        import time
+        teps = []
+        for root in roots:
+            t0 = time.perf_counter()
+            parents = B.bfs(a, jnp.int32(root), plan)
+            parents.data.block_until_ready()
+            dt = time.perf_counter() - t0
+            visited = int((parents.to_global() >= 0).sum())
+            teps.append(visited / dt)
+            if cfg.verbose:
+                print(f"root {root}: {visited} visited, {dt * 1e3:.1f} ms")
+        print(json.dumps({"median_vertices_per_s":
+                          float(np.median(teps))}))
+        return
+    stats = B.graph500_run(grid, scale=cfg.scale,
+                           edgefactor=cfg.edgefactor, nroots=cfg.nroots,
+                           seed=cfg.seed,
+                           validate_roots=cfg.validate_roots,
+                           verbose=cfg.verbose)
+    print(json.dumps(stats.summary()))
+
+
+if __name__ == "__main__":
+    main()
