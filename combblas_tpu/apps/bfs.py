@@ -9,26 +9,28 @@ Graph500 statistics line.
 import dataclasses
 import json
 
+from combblas_tpu.utils.config import BfsConfig
+
 
 @dataclasses.dataclass
-class Config:
-    scale: int = 16
-    edgefactor: int = 16
-    nroots: int = 8
-    seed: int = 1
-    validate_roots: int = 1
+class Config(BfsConfig):
+    """BfsConfig (scale/edgefactor/nroots/seed/alpha/validate_roots/
+    verbose) plus file input."""
     mtx: str = ""                   # read this file instead of generating
-    verbose: bool = False
 
 
 def main(argv=None):
     from combblas_tpu.utils.config import parse_cli
     cfg = parse_cli(Config, argv, prog="bfs")
 
+    import jax
     import jax.numpy as jnp
     import numpy as np
     from combblas_tpu.apps import load_graph
     from combblas_tpu.models import bfs as B
+    from combblas_tpu.parallel import algebra as alg
+    from combblas_tpu.parallel import distvec as dv
+    from combblas_tpu.ops import semiring as S
     from combblas_tpu.parallel.grid import ProcGrid
 
     grid = ProcGrid.make()
@@ -37,13 +39,20 @@ def main(argv=None):
         # 'general' file is completed A|A^T like the reference mains
         a = load_graph(grid, mtx=cfg.mtx, symmetrize=True)
         plan = B.plan_bfs(a)
-        rng = np.random.default_rng(cfg.seed)
-        roots = rng.choice(a.nrows, cfg.nroots, replace=False)
+        # degree-filtered random roots (the SelectCandidates pattern)
+        deg = alg.reduce(S.PLUS, a.astype(jnp.int32), "row")
+        roots = dv.select_candidates(jax.random.key(cfg.seed), deg,
+                                     cfg.nroots)
+        if len(roots) == 0:
+            raise SystemExit("graph has no edges")
         import time
+        # untimed warm-up compile (the reference's untimed iteration 0)
+        B.bfs(a, jnp.int32(roots[0]), plan,
+              alpha=cfg.alpha).data.block_until_ready()
         teps = []
         for root in roots:
             t0 = time.perf_counter()
-            parents = B.bfs(a, jnp.int32(root), plan)
+            parents = B.bfs(a, jnp.int32(root), plan, alpha=cfg.alpha)
             parents.data.block_until_ready()
             dt = time.perf_counter() - t0
             visited = int((parents.to_global() >= 0).sum())
@@ -55,7 +64,7 @@ def main(argv=None):
         return
     stats = B.graph500_run(grid, scale=cfg.scale,
                            edgefactor=cfg.edgefactor, nroots=cfg.nroots,
-                           seed=cfg.seed,
+                           seed=cfg.seed, alpha=cfg.alpha,
                            validate_roots=cfg.validate_roots,
                            verbose=cfg.verbose)
     print(json.dumps(stats.summary()))

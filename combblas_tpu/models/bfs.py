@@ -394,7 +394,7 @@ class BfsRunStats:
 def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
                  nroots: int = 16, seed: int = 1, cap_slack: float = 1.15,
                  validate: bool = False, validate_roots: int = 0,
-                 verbose: bool = False) -> BfsRunStats:
+                 alpha: int = 8, verbose: bool = False) -> BfsRunStats:
     """End-to-end Graph500 kernel-2 harness: generate R-MAT, build the
     symmetric adjacency matrix, run BFS from random roots, report TEPS
     (edges in the traversed component / time, per the reference's
@@ -437,10 +437,10 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
 
     stats = BfsRunStats([], [], [])
     # warm-up compile (not timed, like the reference's untimed iteration 0)
-    bfs(a, jnp.int32(roots[0]), plan).data.block_until_ready()
+    bfs(a, jnp.int32(roots[0]), plan, alpha=alpha).data.block_until_ready()
     for ri, root in enumerate(roots):
         t0 = time.perf_counter()
-        parents = bfs(a, jnp.int32(root), plan)
+        parents = bfs(a, jnp.int32(root), plan, alpha=alpha)
         parents.data.block_until_ready()
         dt = time.perf_counter() - t0
         pg = parents.to_global()

@@ -331,6 +331,21 @@ def uniq(sv: DistSpVec) -> DistSpVec:
         sv, active=_from_flat(sv, keep & act, False))
 
 
+def select_candidates(key, v: DistVec, nand: int) -> np.ndarray:
+    """Uniform random sample of ``nand`` live positions whose value is
+    nonzero (≅ SelectCandidates, FullyDistVec.cpp:196 — the Graph500
+    root-picking primitive). Returns host indices (driver boundary)."""
+    import jax
+    d = np.asarray(_flat(v))
+    cand = np.nonzero(d != 0)[0]
+    if len(cand) == 0:
+        return np.empty((0,), np.int64)
+    take = min(nand, len(cand))
+    picked = jax.random.choice(key, jnp.asarray(cand), (take,),
+                               replace=False)
+    return np.asarray(picked)
+
+
 def concatenate(vecs: list) -> DistVec:
     """Concatenate vectors into one (≅ Concatenate, ParFriends.h:61);
     result aligned like the first."""

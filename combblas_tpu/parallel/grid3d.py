@@ -192,8 +192,8 @@ def summa3d(sr: Semiring, a3: DistSpMat3D, b3: DistSpMat3D, *,
     ParFriends.h:2919): per-layer interval-streaming SUMMA over the
     layer's inner slice, then the fiber merge (all_gather over "l" +
     concat-merge). Returns stacked (pr, pc) C tile arrays replicated
-    across layers, plus the tile geometry — `gather_3d_result` makes a
-    host matrix for verification."""
+    across layers, plus the tile geometry — `_result_to_2d` converts
+    to a DistSpMat on the 2D layer grid."""
     if a3.grid != b3.grid:
         raise ValueError("GRIDMISMATCH")
     if a3.split != "col" or b3.split != "row":
@@ -273,11 +273,25 @@ def summa3d(sr: Semiring, a3: DistSpMat3D, b3: DistSpMat3D, *,
     return cr, cc, cv, cn, tile_m, tile_nb
 
 
+def _result_to_2d(cr, cc, cv, cn, tile_m, tile_n, nrows, ncols,
+                  grid2: "dm.ProcGrid") -> dm.DistSpMat:
+    """Layer-0 C tiles -> a DistSpMat on the 2D layer grid (the
+    Convert2D step, SpParMat3D.cpp:441 — a pure resharding since the
+    result is replicated across layers)."""
+    from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
+    sh3 = grid2.sharding(ROW_AXIS, COL_AXIS, None)
+    sh2 = grid2.sharding(ROW_AXIS, COL_AXIS)
+    return dm.DistSpMat(
+        jax.device_put(cr[0], sh3), jax.device_put(cc[0], sh3),
+        jax.device_put(cv[0], sh3), jax.device_put(cn[0], sh2),
+        grid2, nrows, ncols, tile_m, tile_n)
+
+
 def spgemm_3d(sr: Semiring, grid3: ProcGrid3D, a: dm.DistSpMat,
-              b: dm.DistSpMat, cap_round: int = 4096) -> np.ndarray:
-    """Host-verifiable end-to-end 3D multiply: split 2D operands onto
-    the layers, run summa3d, and gather C as host COO-dense (the
-    SpGEMM3DTest pattern: 3D result compared against 2D)."""
+              b: dm.DistSpMat, cap_round: int = 4096) -> dm.DistSpMat:
+    """End-to-end 3D multiply: split the 2D operands onto the layers,
+    run summa3d, convert the (layer-replicated) result back to A's 2D
+    grid (≅ the SpGEMM3D driver + Convert2D)."""
     a3 = split_to_3d(grid3, a, "col")
     b3 = split_to_3d(grid3, b, "row")
     # plan: per-layer flops are a subset of the 2D plan's; reuse it
@@ -285,22 +299,36 @@ def spgemm_3d(sr: Semiring, grid3: ProcGrid3D, a: dm.DistSpMat,
     fc = -(-fc // cap_round) * cap_round
     oc = -(-oc // cap_round) * cap_round
     cr, cc, cv, cn, tm, tn = summa3d(sr, a3, b3, flops_cap=fc, out_cap=oc)
-    return gather_3d_result(cr, cc, cv, cn, tm, tn, a.nrows, b.ncols,
-                            grid3)
+    return _result_to_2d(cr, cc, cv, cn, tm, tn, a.nrows, b.ncols, a.grid)
 
 
-def gather_3d_result(cr, cc, cv, cn, tile_m, tile_n, nrows, ncols,
-                     grid3: ProcGrid3D) -> np.ndarray:
-    """Layer-0 C tiles -> host dense (verification aid)."""
-    r = np.asarray(cr)[0]
-    c = np.asarray(cc)[0]
-    v = np.asarray(cv)[0]
-    n = np.asarray(cn)[0]
-    out = np.zeros((grid3.pr * tile_m, grid3.pc * tile_n),
-                   np.asarray(v).dtype)
-    for i in range(grid3.pr):
-        for j in range(grid3.pc):
-            k = n[i, j]
-            out[i * tile_m + r[i, j, :k], j * tile_n + c[i, j, :k]] = \
-                v[i, j, :k]
-    return out[:nrows, :ncols]
+def spgemm_3d_phased(sr: Semiring, grid3: ProcGrid3D, a: dm.DistSpMat,
+                     b: dm.DistSpMat, *, phases: Optional[int] = None,
+                     phase_flop_budget: int = 2 ** 28,
+                     prune_hook=None, out_cap: Optional[int] = None,
+                     cap_round: int = 4096) -> dm.DistSpMat:
+    """Memory-constrained 3D SpGEMM (≅ MemEfficientSpGEMM3D,
+    ParFriends.h:3215 — the HipMCL-3D kernel): B column-phased, each
+    phase multiplied on the 3D grid, optional between-phase pruning,
+    phases concatenated on the 2D grid. A is split onto the layers
+    ONCE, outside the phase loop (as the reference does)."""
+    a3 = split_to_3d(grid3, a, "col")
+
+    def mult(bp, p, phases_):
+        b3 = split_to_3d(grid3, bp, "row")
+        fc, oc = spg.plan_spgemm(a, bp)
+        fc = -(-fc // cap_round) * cap_round
+        oc = -(-oc // cap_round) * cap_round
+        if fc > 2 ** 30 - 1:
+            raise ValueError(
+                f"3D phase {p}/{phases_} needs {fc} expansion slots "
+                "(> 2^30); increase phases")
+        cr, cc, cv, cn, tm, tn = summa3d(sr, a3, b3, flops_cap=fc,
+                                         out_cap=oc)
+        return _result_to_2d(cr, cc, cv, cn, tm, tn, a.nrows, bp.ncols,
+                             a.grid)
+
+    return spg.phase_loop(a, b, mult, phases=phases,
+                          phase_flop_budget=phase_flop_budget,
+                          prune_hook=prune_hook, out_cap=out_cap,
+                          cap_round=cap_round)

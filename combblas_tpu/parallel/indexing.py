@@ -98,3 +98,14 @@ def sp_asgn(a: dm.DistSpMat, ri, ci, b: dm.DistSpMat) -> dm.DistSpMat:
 
 def _take_b_if_present(va, vb, a_has, b_has):
     return jnp.where(b_has, vb, va)
+
+
+def induced_subgraph(a: dm.DistSpMat, vertices) -> dm.DistSpMat:
+    """The subgraph induced by a vertex subset — A(vs, vs)
+    (≅ InducedSubgraphs2Procs' extraction core, SpParMat.h:111)."""
+    return subs_ref(a, vertices, vertices)
+
+
+def square(sr, a: dm.DistSpMat) -> dm.DistSpMat:
+    """A ⊗ A (≅ SpParMat::Square, SpParMat.cpp:3398)."""
+    return spg.spgemm(sr, a, a)

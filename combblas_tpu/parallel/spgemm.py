@@ -295,6 +295,25 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     expansion ceiling: per-phase expansions stay small regardless of
     total FLOPs.
     """
+    def mult(bp, p, phases):
+        return _planned_summa(sr, a, bp, cap_round,
+                              f"phase {p}/{phases} of phased SpGEMM")
+
+    return phase_loop(a, b, mult, phases=phases,
+                      phase_flop_budget=phase_flop_budget,
+                      prune_hook=prune_hook, out_cap=out_cap,
+                      cap_round=cap_round)
+
+
+def phase_loop(a: DistSpMat, b: DistSpMat, multiply_window, *,
+               phases: Optional[int] = None,
+               phase_flop_budget: int = 2 ** 28,
+               prune_hook=None, out_cap: Optional[int] = None,
+               cap_round: int = 4096) -> DistSpMat:
+    """The shared column-phasing skeleton (phase-count selection ≅
+    CalculateNumberOfPhases, window loop, optional prune, concat) with
+    the per-window multiply injected — used by the 2D phased SpGEMM
+    and the 3D MemEfficientSpGEMM3D equivalent (parallel.grid3d)."""
     _check_product(a, b)
     if phases is None:
         total = plan_flops_total(a, b)
@@ -305,17 +324,21 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
 
     parts = []
     for p in range(phases):
-        lo = p * w
-        bp = _col_window(b, lo, w)
-        cp = _planned_summa(sr, a, bp, cap_round,
-                            f"phase {p}/{phases} of phased SpGEMM")
+        bp = _col_window(b, p * w, w)
+        cp = multiply_window(bp, p, phases)
         if prune_hook is not None:
             cp = prune_hook(cp)
         parts.append(cp)
+    return concat_col_windows(a, b, parts, cap_round, out_cap)
 
-    # concatenate phase windows back into full-width tiles; a
-    # user-supplied out_cap must hold every surviving entry (no silent
-    # dropping — from_global_coo's contract)
+
+def concat_col_windows(a: DistSpMat, b: DistSpMat, parts: list,
+                       cap_round: int = 4096,
+                       out_cap: Optional[int] = None) -> DistSpMat:
+    """Concatenate per-tile column-window results (from `_col_window`
+    phases, in window order) back into full-width C tiles (≅
+    ColConcatenate). A user-supplied out_cap must hold every surviving
+    entry (no silent dropping — from_global_coo's contract)."""
     need = int(np.asarray(sum(np.asarray(p.nnz, np.int64)
                               for p in parts)).max())
     if out_cap is None:

@@ -53,7 +53,7 @@ def test_summa3d_matches_2d(rng, grid2, grid3):
     a = dm.from_dense(S.PLUS, grid2, da, 0.0)
     b = dm.from_dense(S.PLUS, grid2, db, 0.0)
     got = g3.spgemm_3d(S.PLUS_TIMES_F32, grid3, a, b)
-    np.testing.assert_allclose(got, da @ db, rtol=1e-4)
+    np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ db, rtol=1e-4)
 
 
 def test_summa3d_uneven_dims(rng, grid2, grid3):
@@ -62,7 +62,37 @@ def test_summa3d_uneven_dims(rng, grid2, grid3):
     a = dm.from_dense(S.PLUS, grid2, da, 0.0)
     b = dm.from_dense(S.PLUS, grid2, db, 0.0)
     got = g3.spgemm_3d(S.PLUS_TIMES_F32, grid3, a, b)
-    np.testing.assert_allclose(got, da @ db, rtol=1e-4)
+    assert (got.nrows, got.ncols) == (13, 15)
+    np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ db, rtol=1e-4)
+
+
+def test_spgemm_3d_phased(rng, grid2, grid3):
+    n = 16
+    da = _sparse(rng, n, n, 0.4)
+    a = dm.from_dense(S.PLUS, grid2, da, 0.0)
+    got = g3.spgemm_3d_phased(S.PLUS_TIMES_F32, grid3, a, a, phases=2)
+    np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ da, rtol=1e-4)
+
+
+def test_spgemm_3d_phased_prune_hook(rng, grid2, grid3):
+    from combblas_tpu.parallel import algebra as alg
+    n = 12
+    da = _sparse(rng, n, n, 0.5)
+    a = dm.from_dense(S.PLUS, grid2, da, 0.0)
+    got = g3.spgemm_3d_phased(S.PLUS_TIMES_F32, grid3, a, a, phases=2,
+                              prune_hook=_prune_small)
+    exp = da @ da
+    exp[exp < 0.2] = 0.0
+    np.testing.assert_allclose(dm.to_dense(got, 0.0), exp, rtol=1e-4)
+
+
+def _prune_small(c):
+    from combblas_tpu.parallel import algebra as alg
+    return alg.prune(c, _below)
+
+
+def _below(v):
+    return v < 0.2
 
 
 def test_rejects_mismatched_split(rng, grid2, grid3):

@@ -145,6 +145,30 @@ def test_binary_converters(tmp_path, rng, grid):
     np.testing.assert_allclose(dm.to_dense(b, 0.0), d, rtol=1e-6)
 
 
+def test_square_and_induced_subgraph(rng, grid):
+    from combblas_tpu.parallel import indexing as ix
+    d = _sparse(rng, 14, 14)
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    sq = ix.square(S.PLUS_TIMES_F32, a)
+    np.testing.assert_allclose(dm.to_dense(sq, 0.0), d @ d, rtol=1e-4)
+    vs = np.array([2, 5, 9, 11])
+    sub = ix.induced_subgraph(a, vs)
+    np.testing.assert_allclose(dm.to_dense(sub, 0.0),
+                               d[np.ix_(vs, vs)], rtol=1e-5)
+
+
+def test_select_candidates(rng, grid):
+    import jax
+    vals = np.zeros(60, np.float32)
+    nz = rng.choice(60, 25, replace=False)
+    vals[nz] = 1.0
+    v = dv.from_global(grid, ROW_AXIS, jnp.asarray(vals))
+    picked = dv.select_candidates(jax.random.key(0), v, 10)
+    assert len(picked) == 10
+    assert set(picked) <= set(nz.tolist())
+    assert len(set(picked.tolist())) == 10     # no repeats
+
+
 def test_galerkin_triple_product(rng, grid):
     """R * A * R^T restriction chain (≅ Driver.cpp's galerkin
     products) via two SUMMA calls."""
