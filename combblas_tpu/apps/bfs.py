@@ -15,7 +15,10 @@ from combblas_tpu.utils.config import BfsConfig
 @dataclasses.dataclass
 class Config(BfsConfig):
     """BfsConfig (scale/edgefactor/nroots/seed/alpha/validate_roots/
-    verbose) plus file input."""
+    verbose) plus file input. Defaults are interactive-friendly —
+    the bench harness (bench.py) owns the scale-22/64-root config."""
+    scale: int = 16
+    nroots: int = 8
     mtx: str = ""                   # read this file instead of generating
 
 

@@ -278,7 +278,6 @@ def _result_to_2d(cr, cc, cv, cn, tile_m, tile_n, nrows, ncols,
     """Layer-0 C tiles -> a DistSpMat on the 2D layer grid (the
     Convert2D step, SpParMat3D.cpp:441 — a pure resharding since the
     result is replicated across layers)."""
-    from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
     sh3 = grid2.sharding(ROW_AXIS, COL_AXIS, None)
     sh2 = grid2.sharding(ROW_AXIS, COL_AXIS)
     return dm.DistSpMat(
@@ -319,7 +318,7 @@ def spgemm_3d_phased(sr: Semiring, grid3: ProcGrid3D, a: dm.DistSpMat,
         fc, oc = spg.plan_spgemm(a, bp)
         fc = -(-fc // cap_round) * cap_round
         oc = -(-oc // cap_round) * cap_round
-        if fc > 2 ** 30 - 1:
+        if fc > spg._SAT:
             raise ValueError(
                 f"3D phase {p}/{phases_} needs {fc} expansion slots "
                 "(> 2^30); increase phases")
