@@ -22,6 +22,7 @@ class Config:
     recover_num: int = 1400         # -R
     recover_pct: float = 0.9
     phases: int = 0                 # 0 = auto
+    per_process_mem_gb: float = 0.0  # -per-process-mem (0 = unset)
     max_iters: int = 60
     o: str = ""                     # output cluster file
     verbose: bool = False
@@ -52,7 +53,9 @@ def main(argv=None):
         inflation=cfg.inflation, prune_threshold=cfg.prune_threshold,
         select=cfg.select, recover_num=cfg.recover_num,
         recover_pct=cfg.recover_pct,
-        phases=cfg.phases or None, max_iters=cfg.max_iters)
+        phases=cfg.phases or None,
+        per_process_mem_gb=cfg.per_process_mem_gb or None,
+        max_iters=cfg.max_iters)
     labels, ncl, iters = M.mcl(a, params, verbose=cfg.verbose)
     lg = np.asarray(labels.to_global())
     if cfg.o:

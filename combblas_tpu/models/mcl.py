@@ -41,8 +41,19 @@ class MclParams:
     recover_pct: float = 0.9        # -pct (mass fraction triggering recovery)
     phases: Optional[int] = None    # -phases (None: auto from flop budget)
     phase_flop_budget: int = 2 ** 27
+    #: -per-process-mem: per-device memory budget in GiB; when set it
+    #: derives phase_flop_budget (≅ the auto-phase estimation from
+    #: perProcessMemory, ParFriends.h:483-536). Each ESC expansion slot
+    #: costs ~24 bytes through the sort (row+col+val in and out).
+    per_process_mem_gb: Optional[float] = None
     max_iters: int = 100
     chaos_eps: float = 1e-3         # convergence threshold on chaos
+
+    def effective_flop_budget(self) -> int:
+        if self.per_process_mem_gb is not None:
+            return max(2 ** 20,
+                       int(self.per_process_mem_gb * 2 ** 30 / 24))
+        return self.phase_flop_budget
 
 
 def _inv_or_zero(v):
@@ -149,10 +160,10 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     hook = partial(mcl_prune_select_recover, p=params)
     it = 0
     while ch > params.chaos_eps and it < params.max_iters:
-        a = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
-                              phases=params.phases,
-                              phase_flop_budget=params.phase_flop_budget,
-                              prune_hook=hook)
+        a = spg.spgemm_phased(
+            S.PLUS_TIMES_F32, a, a, phases=params.phases,
+            phase_flop_budget=params.effective_flop_budget(),
+            prune_hook=hook)
         a = inflate(a, params.inflation)
         ch = chaos(a)
         it += 1

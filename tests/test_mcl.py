@@ -89,6 +89,32 @@ def test_mcl_planted_partition(grid):
         assert (blk == blk[0]).all(), f"block {b} split: {blk}"
 
 
+def test_per_process_mem_budget():
+    p = M.MclParams(per_process_mem_gb=1.0)
+    assert p.effective_flop_budget() == 2 ** 30 // 24
+    p2 = M.MclParams(phase_flop_budget=12345)
+    assert p2.effective_flop_budget() == 12345
+
+
+def test_mem_budget_forces_multiphase_same_result(rng, grid):
+    # the derived budget must actually split the expansion into
+    # multiple phases (total flops above the 2^20 floor) and still
+    # reproduce the single-shot product
+    from combblas_tpu.parallel import spgemm as spg
+    n = 256
+    d = rng.random((n, n), dtype=np.float32)
+    d[rng.random((n, n)) > 0.3] = 0
+    a = dm.from_dense(S.PLUS, grid, d, 0.0)
+    budget = M.MclParams(per_process_mem_gb=1e-6).effective_flop_budget()
+    assert spg.plan_flops_total(a, a) > budget, \
+        "graph too small to exercise multi-phase"
+    c1 = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
+                           phase_flop_budget=budget)
+    c2 = spg.spgemm(S.PLUS_TIMES_F32, a, a)
+    np.testing.assert_allclose(dm.to_dense(c1, 0.0),
+                               dm.to_dense(c2, 0.0), rtol=1e-4)
+
+
 def test_mcl_two_cliques(grid):
     # two 6-cliques joined by one edge -> 2 clusters
     n = 12

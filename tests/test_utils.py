@@ -1,0 +1,53 @@
+"""utils subsystem tests: phase timers and the config CLI bridge."""
+
+import time
+
+import pytest
+
+from combblas_tpu.utils import Timers, PHASES, parse_cli
+from combblas_tpu.utils.config import BfsConfig, SpGemmBenchConfig
+
+
+class TestTimers:
+    def test_accumulates(self):
+        t = Timers()
+        with t.phase("fan_out"):
+            time.sleep(0.01)
+        with t.phase("fan_out"):
+            time.sleep(0.01)
+        with t.phase("merge"):
+            pass
+        rep = t.report()
+        assert rep["fan_out"]["calls"] == 2
+        assert rep["fan_out"]["total_s"] >= 0.02
+        assert rep["merge"]["calls"] == 1
+
+    def test_timed_blocks_on_result(self):
+        import jax.numpy as jnp
+        t = Timers()
+        out = t.timed("local", jnp.arange, 100)
+        assert out.shape == (100,)
+        assert t.report()["local"]["calls"] == 1
+
+    def test_phase_taxonomy_names(self):
+        assert PHASES == ("fan_out", "local", "fan_in", "merge")
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = parse_cli(BfsConfig, [])
+        assert cfg.scale == 22 and cfg.nroots == 64 and cfg.alpha == 8
+
+    def test_overrides_and_underscores(self):
+        cfg = parse_cli(BfsConfig, ["--scale", "14",
+                                    "--validate-roots", "3"])
+        assert cfg.scale == 14 and cfg.validate_roots == 3
+
+    def test_bool_flag(self):
+        cfg = parse_cli(BfsConfig, ["--verbose"])
+        assert cfg.verbose is True
+        assert parse_cli(BfsConfig, []).verbose is False
+
+    def test_second_config_class(self):
+        cfg = parse_cli(SpGemmBenchConfig, ["--scale", "12"])
+        assert cfg.scale == 12 and cfg.edgefactor == 16
