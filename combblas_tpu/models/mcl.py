@@ -49,10 +49,14 @@ class MclParams:
     max_iters: int = 100
     chaos_eps: float = 1e-3         # convergence threshold on chaos
 
-    def effective_flop_budget(self) -> int:
+    def effective_flop_budget(self, nproc: int = 1) -> int:
+        """Phase flop budget. The memory knob is PER DEVICE while the
+        phase count divides the GLOBAL flop total, so aggregate
+        capacity scales with the device count (≅ the nprocs scaling in
+        CalculateNumberOfPhases, ParFriends.h:733)."""
         if self.per_process_mem_gb is not None:
             return max(2 ** 20,
-                       int(self.per_process_mem_gb * 2 ** 30 / 24))
+                       int(self.per_process_mem_gb * nproc * 2 ** 30 / 24))
         return self.phase_flop_budget
 
 
@@ -159,10 +163,11 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     ch = float("inf")
     hook = partial(mcl_prune_select_recover, p=params)
     it = 0
+    nproc = a.grid.pr * a.grid.pc
     while ch > params.chaos_eps and it < params.max_iters:
         a = spg.spgemm_phased(
             S.PLUS_TIMES_F32, a, a, phases=params.phases,
-            phase_flop_budget=params.effective_flop_budget(),
+            phase_flop_budget=params.effective_flop_budget(nproc),
             prune_hook=hook)
         a = inflate(a, params.inflation)
         ch = chaos(a)

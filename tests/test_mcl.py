@@ -92,8 +92,11 @@ def test_mcl_planted_partition(grid):
 def test_per_process_mem_budget():
     p = M.MclParams(per_process_mem_gb=1.0)
     assert p.effective_flop_budget() == 2 ** 30 // 24
+    # the per-DEVICE budget scales by device count against the GLOBAL
+    # flop total (aggregate capacity, as in CalculateNumberOfPhases)
+    assert p.effective_flop_budget(nproc=8) == 8 * 2 ** 30 // 24
     p2 = M.MclParams(phase_flop_budget=12345)
-    assert p2.effective_flop_budget() == 12345
+    assert p2.effective_flop_budget(nproc=8) == 12345
 
 
 def test_mem_budget_forces_multiphase_same_result(rng, grid):

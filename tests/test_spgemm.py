@@ -150,6 +150,26 @@ class TestBlockDriver:
         np.testing.assert_allclose(got, exp, rtol=1e-5)
 
 
+class TestFuzz:
+    def test_random_shapes_vs_scipy(self, grid24):
+        """Randomized consistency sweep: random rectangular products
+        on the non-square grid vs scipy (the HashSpGEMMTest pattern
+        broadened across shapes)."""
+        import scipy.sparse as sp
+        rng = np.random.default_rng(123)
+        for trial in range(6):
+            m, k, n = rng.integers(5, 40, 3)
+            da = random_sparse(rng, m, k, float(rng.uniform(0.1, 0.5)))
+            db = random_sparse(rng, k, n, float(rng.uniform(0.1, 0.5)))
+            a = DM.from_dense(S.PLUS, grid24, da, 0.0)
+            b = DM.from_dense(S.PLUS, grid24, db, 0.0)
+            c = SPG.spgemm(S.PLUS_TIMES_F32, a, b)
+            exp = (sp.csr_matrix(da) @ sp.csr_matrix(db)).toarray()
+            np.testing.assert_allclose(
+                DM.to_dense(c, 0.0), exp, rtol=1e-4,
+                err_msg=f"trial {trial}: {m}x{k} @ {k}x{n}")
+
+
 class TestTransposeAnyGrid:
     def test_transpose_nonsquare_grid(self, rng, grid24):
         d = random_sparse(rng, 18, 27)
