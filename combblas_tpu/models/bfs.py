@@ -217,7 +217,7 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
             seed = seed.at[tgt].set(actb[0].astype(jnp.int8),
                                     mode="drop")[:cap]
             seed_t = tl.to_chunked(seed, fill=0)
-            eact_c, _ = tl.seg_scan_core(
+            eact_c = tl.seg_scan_values(
                 S.MAX, seed_t, crun_t.reshape(chunk_len, 128))
             # (2) route bits to row order: pack the frontier bit into
             # the low bit of the (distinct) col->row key and sort ONE

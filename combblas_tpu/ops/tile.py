@@ -295,6 +295,20 @@ def seg_scan_core(monoid: Monoid, d2: Array, f2: Array):
     return xx, ff
 
 
+def seg_scan_values(monoid: Monoid, d2: Array, f2: Array) -> Array:
+    """Values of the inclusive segmented scan over the chunk-column
+    layout. Dispatches to the single-pass Pallas kernel when enabled
+    (COMBBLAS_TPU_PALLAS=1 on a TPU backend — ops.pallas_kernels),
+    otherwise the XLA associative-scan reference path."""
+    from combblas_tpu.ops import pallas_kernels as pk
+    if pk.enabled():
+        import numpy as np
+        iv = np.asarray(monoid.identity(d2.dtype)).item()
+        return pk.seg_scan_values(d2, f2, combine=monoid.combine,
+                                  ident_val=iv)
+    return seg_scan_core(monoid, d2, f2)[0]
+
+
 def _seg_scan_2d(monoid: Monoid, data: Array, starts: Array,
                  nchunks: int):
     """Inclusive segmented scan; returns ((L, C) scanned array, L)
@@ -302,7 +316,7 @@ def _seg_scan_2d(monoid: Monoid, data: Array, starts: Array,
     ident = monoid.identity(data.dtype)
     d2 = to_chunked(data, nchunks, fill=ident)
     f2 = to_chunked(starts, nchunks, fill=True)
-    xx, _ = seg_scan_core(monoid, d2, f2)
+    xx = seg_scan_values(monoid, d2, f2)
     return xx, d2.shape[0]
 
 
@@ -337,7 +351,7 @@ def seg_reduce_pre(monoid: Monoid, d2: Array, f2: Array,
     """seg_reduce_sorted for inputs already in the chunk-column layout
     (data and flags via `to_chunked`, positions via `chunked_pos`) —
     the zero-copy per-level path when the layout is precomputed."""
-    xx, _ = seg_scan_core(monoid, d2, f2)
+    xx = seg_scan_values(monoid, d2, f2)
     out = xx.ravel()[jnp.clip(ends_mapped, 0, xx.size - 1)]
     return jnp.where(nonempty, out, monoid.identity(d2.dtype))
 

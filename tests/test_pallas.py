@@ -1,0 +1,102 @@
+"""Pallas segmented-scan kernel: interpret-mode correctness against
+the XLA associative-scan reference (runs everywhere; the real-TPU
+compile path is gated behind COMBBLAS_TPU_PALLAS=1).
+
+The flags here deliberately do NOT force a segment start at every
+column top: the chunk-column layout's columns are consecutive sequence
+chunks, so segments MUST flow across column boundaries through the
+cross-column carry stitch (the bug class a flag-at-every-top fixture
+would hide)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu.ops import pallas_kernels as pk
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile as tl
+
+
+def _ref(monoid, d2, f2):
+    return np.asarray(tl.seg_scan_core(monoid, jnp.asarray(d2),
+                                       jnp.asarray(f2))[0])
+
+
+def _pallas(monoid, d2, f2):
+    iv = np.asarray(monoid.identity(jnp.asarray(d2).dtype)).item()
+    return np.asarray(pk.seg_scan_values(
+        jnp.asarray(d2), jnp.asarray(f2), combine=monoid.combine,
+        ident_val=iv, interpret=True))
+
+
+@pytest.mark.parametrize("L", [1, 7, 512, 513, 1100])
+def test_max_scan_matches_reference(rng, L):
+    d2 = rng.integers(-50, 50, (L, 128)).astype(np.int32)
+    f2 = rng.random((L, 128)) < 0.2     # segments cross column bounds
+    np.testing.assert_array_equal(_pallas(S.MAX, d2, f2),
+                                  _ref(S.MAX, d2, f2))
+
+
+def test_plus_scan_float(rng):
+    L = 700
+    d2 = rng.random((L, 128)).astype(np.float32)
+    f2 = rng.random((L, 128)) < 0.1
+    np.testing.assert_allclose(_pallas(S.PLUS, d2, f2),
+                               _ref(S.PLUS, d2, f2), rtol=1e-5)
+
+
+def test_min_scan_no_flags_at_all(rng):
+    # a single segment spanning every chunk: both the block carry and
+    # the cross-column carry must thread end to end
+    L = 1536
+    d2 = rng.integers(0, 1000, (L, 128)).astype(np.int32)
+    f2 = np.zeros((L, 128), bool)
+    np.testing.assert_array_equal(_pallas(S.MIN, d2, f2),
+                                  _ref(S.MIN, d2, f2))
+
+
+def test_sparse_flags_cross_chunks(rng):
+    # ~one flag per two columns: many segments span chunk boundaries
+    L = 520
+    d2 = rng.integers(-9, 9, (L, 128)).astype(np.int32)
+    f2 = rng.random((L, 128)) < (0.5 / L)
+    np.testing.assert_array_equal(_pallas(S.MAX, d2, f2),
+                                  _ref(S.MAX, d2, f2))
+
+
+def test_int8_frontier_scan(rng):
+    # the BFS dense-step dtype (int8 seed bits, MAX copy-scan)
+    L = 600
+    d2 = (rng.random((L, 128)) < 0.05).astype(np.int8)
+    f2 = rng.random((L, 128)) < 0.3
+    np.testing.assert_array_equal(_pallas(S.MAX, d2, f2),
+                                  _ref(S.MAX, d2, f2))
+
+
+def test_real_tile_row_structure(rng):
+    """The exact (data, flags) shapes the SpMV kernel feeds the scan:
+    row-run starts over a padded sorted tile."""
+    from combblas_tpu.ops import generate
+    from combblas_tpu.ops import semiring as SS
+    r, c = generate.rmat_edges(jax.random.key(3), scale=9, edgefactor=8)
+    n = 1 << 9
+    t = tl.from_coo(SS.LOR, r, c, jnp.ones_like(r, jnp.bool_),
+                    nrows=n, ncols=n, cap=int(r.shape[0]) + 64)
+    starts, ends, nonempty = tl.row_structure(t)
+    data = jnp.where(t.valid(), 1, 0).astype(jnp.int32)
+    d2 = tl.to_chunked(data, fill=0)
+    f2 = tl.to_chunked(starts, fill=True)
+    np.testing.assert_array_equal(_pallas(S.PLUS, np.asarray(d2),
+                                          np.asarray(f2)),
+                                  _ref(S.PLUS, d2, f2))
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("COMBBLAS_TPU_PALLAS", raising=False)
+    assert pk.enabled() is False
+    monkeypatch.setenv("COMBBLAS_TPU_PALLAS", "0")
+    assert pk.enabled() is False
+    # "1" still requires a TPU backend, absent in the test env
+    monkeypatch.setenv("COMBBLAS_TPU_PALLAS", "1")
+    assert pk.enabled() is (jax.default_backend() == "tpu")
