@@ -26,6 +26,25 @@ import jax.numpy as jnp
 _BL = 512                      # row-block (multiple of 32: int8 tiling)
 
 
+class _BoolCombine:
+    """Run a boolean monoid combine on int8 carriers (bool data rides
+    VMEM as int8). Hash/eq delegate to the wrapped combine so the jit
+    cache keys stay stable."""
+
+    def __init__(self, combine):
+        self.combine = combine
+
+    def __call__(self, a, b):
+        return self.combine(a != 0, b != 0).astype(jnp.int8)
+
+    def __hash__(self):
+        return hash(("_BoolCombine", self.combine))
+
+    def __eq__(self, other):
+        return (isinstance(other, _BoolCombine)
+                and self.combine == other.combine)
+
+
 def enabled() -> bool:
     """Use the Pallas scan? Opt-in via COMBBLAS_TPU_PALLAS=1 on a TPU
     backend (interpret-mode fallback elsewhere is slower than XLA)."""
@@ -35,6 +54,19 @@ def enabled() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def is_batched(x) -> bool:
+    """True when ``x`` is inside a vmap trace. The kernel's
+    sequential-carry design (program_id(0) + one carry scratch) is not
+    batch-safe — pallas_call's batching rule would add a grid dim the
+    carry logic ignores — so vmapped callers (SpMM's width axis, the
+    per-tile vmaps of the algebra layer) take the XLA path."""
+    try:
+        from jax._src.interpreters import batching  # jax 0.9: private
+        return isinstance(x, batching.BatchTracer)
+    except Exception:
+        return True     # can't tell: stay on the safe XLA path
 
 
 def _block_seg_scan(x, f, combine, ident):
@@ -104,9 +136,14 @@ def seg_scan_values(d2, f2, *, combine, ident_val,
         d2 = jnp.pad(d2, ((0, padL - L), (0, 0)),
                      constant_values=ident_val)
         f2 = jnp.pad(f2, ((0, padL - L), (0, 0)), constant_values=True)
-    # Mosaic rejects bool VMEM operands: ship flags as int8 (the kernel
-    # casts back; outputs/scratch are int8 for the same reason)
+    # Mosaic rejects bool VMEM operands: ship flags (and bool data,
+    # e.g. LOR-monoid tiles) as int8; results cast back
     f2 = f2.astype(jnp.int8)
+    was_bool = d2.dtype == jnp.bool_
+    if was_bool:
+        d2 = d2.astype(jnp.int8)
+        combine = _BoolCombine(combine)
+        ident_val = int(bool(ident_val))
 
     kernel = functools.partial(_seg_scan_kernel, combine=combine,
                                ident_val=ident_val)
@@ -144,4 +181,5 @@ def seg_scan_values(d2, f2, *, combine, ident_val,
 
     cf, cx = lax.associative_scan(op, (ff[-1], xx[-1]))
     prev = jnp.concatenate([jnp.full((1,), ident, xx.dtype), cx[:-1]])
-    return jnp.where(ff, xx, combine(prev[None, :], xx))
+    out = jnp.where(ff, xx, combine(prev[None, :], xx))
+    return (out > 0) if was_bool else out

@@ -301,7 +301,7 @@ def seg_scan_values(monoid: Monoid, d2: Array, f2: Array) -> Array:
     (COMBBLAS_TPU_PALLAS=1 on a TPU backend — ops.pallas_kernels),
     otherwise the XLA associative-scan reference path."""
     from combblas_tpu.ops import pallas_kernels as pk
-    if pk.enabled():
+    if pk.enabled() and not pk.is_batched(d2):
         import numpy as np
         iv = np.asarray(monoid.identity(d2.dtype)).item()
         return pk.seg_scan_values(d2, f2, combine=monoid.combine,

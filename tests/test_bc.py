@@ -55,6 +55,14 @@ class TestDense:
         dd = dn.dense_from_global(grid, d)
         np.testing.assert_allclose(dd.to_global(), d, rtol=1e-6)
 
+    def test_constant_constructors(self, grid):
+        dd = dn.dense_constant(grid, 9, 14, 2.5)
+        np.testing.assert_allclose(dd.to_global(),
+                                   np.full((9, 14), 2.5), rtol=1e-6)
+        mv = dn.mv_constant(grid, ROW_AXIS, 11, 3, 7.0)
+        np.testing.assert_allclose(mv.to_global(),
+                                   np.full((11, 3), 7.0), rtol=1e-6)
+
     def test_ewise_scale(self, rng, grid):
         sp = rng.random((17, 13)).astype(np.float32)
         sp[rng.random((17, 13)) > 0.3] = 0

@@ -63,21 +63,27 @@ class TestBFS:
         assert len(stats.teps) == 3
 
 
+@pytest.fixture(scope="module")
+def crosscheck_setup(grid22):
+    """One matrix + plan + jitted steppers shared by every cross-check
+    parametrization (stepper compiles dominate on the 1-core host)."""
+    from combblas_tpu.ops import generate
+    scale, ef, seed = 9, 4, 2
+    n = 1 << scale
+    r, c = generate.rmat_edges(jax.random.key(seed), scale, ef)
+    r, c = generate.symmetrize(r, c)
+    a = DM.from_global_coo(S.LOR, grid22, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    plan = B.plan_bfs(a)
+    tiers, steppers = B.build_steppers(a, plan)
+    return a, plan, n, tiers, steppers
+
+
 class TestStepperCrossCheck:
     """Force every sparse tier and the dense stepper on the SAME
     frontier and require identical parent candidates — no tier's bugs
     can hide behind the direction-optimizing switch (≅ the reference's
     SpMSpV-algorithm cross-checks, SpMSpVBench.cpp:531-539)."""
-
-    def _setup(self, grid, scale=9, ef=4, seed=2):
-        from combblas_tpu.ops import generate
-        n = 1 << scale
-        r, c = generate.rmat_edges(jax.random.key(seed), scale, ef)
-        r, c = generate.symmetrize(r, c)
-        a = DM.from_global_coo(S.LOR, grid, r, c,
-                               jnp.ones_like(r, jnp.bool_), n, n)
-        plan = B.plan_bfs(a)
-        return a, plan, n
 
     def _fits(self, a, plan, act, ec, fc):
         actdeg = np.einsum("ijk,jk->ij", np.asarray(plan.cdeg),
@@ -86,9 +92,8 @@ class TestStepperCrossCheck:
         return actdeg.max() <= ec and nact_blk <= fc
 
     @pytest.mark.parametrize("frontier", ["single", "level2", "wide"])
-    def test_all_fitting_steppers_agree(self, grid22, frontier):
-        a, plan, n = self._setup(grid22)
-        tiers, steppers = B.build_steppers(a, plan)
+    def test_all_fitting_steppers_agree(self, crosscheck_setup, frontier):
+        a, plan, n, tiers, steppers = crosscheck_setup
 
         act = np.zeros((a.grid.pc, a.tile_n), bool)
         rng = np.random.default_rng(0)
@@ -120,12 +125,11 @@ class TestStepperCrossCheck:
                 checked += 1
         assert checked >= 1, "no sparse tier fit this frontier; widen caps"
 
-    def test_tier_budgets_sane(self, grid22):
+    def test_tier_budgets_sane(self, crosscheck_setup):
         # budgets ascend (smallest tier first) and respect the floor;
         # at toy caps all tiers may clamp to the same floor — the
         # distinctness only appears at bench scale
-        a, plan, n = self._setup(grid22)
-        tiers = B._caps(a)
+        a, plan, n, tiers, steppers = crosscheck_setup
         assert len(tiers) == 3
         ecs = [ec for ec, _ in tiers]
         assert ecs == sorted(ecs)

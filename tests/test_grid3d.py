@@ -66,19 +66,16 @@ def test_summa3d_uneven_dims(rng, grid2, grid3):
     np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ db, rtol=1e-4)
 
 
-def test_spgemm_3d_phased(rng, grid2, grid3):
+def test_spgemm_3d_phased_with_and_without_prune(rng, grid2, grid3):
+    # one fixture matrix covers both the default (no-hook) branch and
+    # the between-phase prune hook (columns are disjoint across
+    # phases, so pruning per phase == pruning the product)
     n = 16
     da = _sparse(rng, n, n, 0.4)
     a = dm.from_dense(S.PLUS, grid2, da, 0.0)
-    got = g3.spgemm_3d_phased(S.PLUS_TIMES_F32, grid3, a, a, phases=2)
-    np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ da, rtol=1e-4)
-
-
-def test_spgemm_3d_phased_prune_hook(rng, grid2, grid3):
-    from combblas_tpu.parallel import algebra as alg
-    n = 12
-    da = _sparse(rng, n, n, 0.5)
-    a = dm.from_dense(S.PLUS, grid2, da, 0.0)
+    plain = g3.spgemm_3d_phased(S.PLUS_TIMES_F32, grid3, a, a, phases=2)
+    np.testing.assert_allclose(dm.to_dense(plain, 0.0), da @ da,
+                               rtol=1e-4)
     got = g3.spgemm_3d_phased(S.PLUS_TIMES_F32, grid3, a, a, phases=2,
                               prune_hook=_prune_small)
     exp = da @ da

@@ -92,6 +92,32 @@ def test_real_tile_row_structure(rng):
                                   _ref(S.PLUS, d2, f2))
 
 
+def test_bool_data_lor_scan(rng):
+    # bool tiles (LOR monoid) must ride VMEM as int8 and come back bool
+    L = 520
+    d2 = rng.random((L, 128)) < 0.1
+    f2 = rng.random((L, 128)) < 0.2
+    got = _pallas(S.LOR, d2, f2)
+    assert got.dtype == np.bool_
+    np.testing.assert_array_equal(got, _ref(S.LOR, d2, f2))
+
+
+def test_vmap_detection():
+    import jax
+    from combblas_tpu.ops import pallas_kernels as pk2
+    seen = []
+
+    def f(x):
+        seen.append(pk2.is_batched(x))
+        return x * 2
+
+    jax.vmap(f)(jnp.ones((3, 4)))
+    assert seen == [True]
+    seen.clear()
+    jax.jit(f)(jnp.ones((4,)))
+    assert seen == [False]
+
+
 def test_disabled_by_default(monkeypatch):
     monkeypatch.delenv("COMBBLAS_TPU_PALLAS", raising=False)
     assert pk.enabled() is False
