@@ -10,48 +10,37 @@ back to the pure-Python parser.
 from __future__ import annotations
 
 import ctypes
-import hashlib
 import pathlib
-import subprocess
 
-_DIR = pathlib.Path(__file__).parent
-_SRC = _DIR / "_mmparse.cpp"
-_BUILD = _DIR / "_build"
+from combblas_tpu.utils.native import load_native
+
+_SRC = pathlib.Path(__file__).parent / "_mmparse.cpp"
 
 _lib = None
 _tried = False
 
 
+def _configure(lib):
+    lib.mm_read_header.restype = ctypes.c_int
+    lib.mm_read_header.argtypes = [ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_longlong)]
+    lib.mm_read_body.restype = ctypes.c_longlong
+    lib.mm_read_body.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_longlong]
+    lib.mm_write.restype = ctypes.c_int
+    lib.mm_write.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+        ctypes.c_int]
+
+
 def load():
     """The loaded CDLL, building it if needed; None if unavailable."""
     global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    _tried = True
-    try:
-        tag = hashlib.sha1(_SRC.read_bytes()).hexdigest()[:12]
-        so = _BUILD / f"_mmparse_{tag}.so"
-        if not so.exists():
-            _BUILD.mkdir(exist_ok=True)
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(so)],
-                check=True, capture_output=True, timeout=120)
-        lib = ctypes.CDLL(str(so))
-        lib.mm_read_header.restype = ctypes.c_int
-        lib.mm_read_header.argtypes = [ctypes.c_char_p,
-                                       ctypes.POINTER(ctypes.c_longlong)]
-        lib.mm_read_body.restype = ctypes.c_longlong
-        lib.mm_read_body.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
-            ctypes.c_longlong]
-        lib.mm_write.restype = ctypes.c_int
-        lib.mm_write.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
-            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
-            ctypes.c_int]
-        _lib = lib
-    except Exception:
-        _lib = None
+    if not _tried:
+        _tried = True
+        _lib = load_native(_SRC, _configure)
     return _lib

@@ -44,6 +44,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from combblas_tpu.ops import generate
+from combblas_tpu.ops import route as rt
 from combblas_tpu.ops import semiring as S
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.parallel import distmat as dm
@@ -78,6 +79,13 @@ class BfsPlan:
     cdeg: jax.Array       # (pr, pc, tile_n) int32 — per-column degree
     crun_t: jax.Array     # (pr, pc, capp) bool — column-run starts, chunked
     c2r: jax.Array        # (pr, pc, cap) int32 — col-order -> row-order key
+    # Beneš route masks for the static col->row edge permutation
+    # (ops/route.py): (pr, pc, nstages, npad/32) uint32, or None when
+    # the plan was built without routing (the dense stepper then falls
+    # back to the permute-by-sort path). Built host-side by plan_bfs
+    # once per matrix — the untimed Graph500 kernel-1 analogue of
+    # OptimizeForGraph500 (SpParMat.cpp:3285).
+    route_masks: jax.Array | None = None
     # consistency token: the source matrix's static signature. A plan is
     # valid ONLY for the exact matrix it was built from (same tiles, same
     # nnz, same entry order); `bfs` asserts the static part at trace time.
@@ -89,7 +97,7 @@ class BfsPlan:
 
 
 @jax.jit
-def plan_bfs(a: dm.DistSpMat) -> BfsPlan:
+def _plan_bfs_core(a: dm.DistSpMat) -> BfsPlan:
     pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
 
     def one(rows, cols, vals, nnz):
@@ -114,15 +122,58 @@ def plan_bfs(a: dm.DistSpMat) -> BfsPlan:
     return BfsPlan(*fields, sig=(pr, pc, cap, a.tile_m, a.tile_n))
 
 
+def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
+             route_budget_s: float = 900.0) -> BfsPlan:
+    """Build the BFS traversal plan (device part jitted).
+
+    ``route=True`` additionally compiles the static col->row edge
+    permutation of every tile into Beneš swap masks (ops/route.py) so
+    the dense stepper routes frontier bits with word-parallel
+    delta-swaps instead of a per-level O(cap) int32 sort.  The mask
+    computation is host-side O(cap log cap) per tile — one-off per
+    matrix, amortized over roots (Graph500 kernel-1 is untimed).
+    ``route="auto"`` enables it only when the estimated planning time
+    fits ``route_budget_s`` (calibrated ~60ns per slot-depth on one
+    host core)."""
+    plan = _plan_bfs_core(a)
+    if not route:
+        return plan
+    pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
+    npad = 1 << max(5, (cap - 1).bit_length())
+    if route == "auto":
+        # ~60ns/slot-depth is the native router's measured rate on one
+        # host core; the pure-Python fallback is ~3 orders slower, so
+        # auto only engages when the native library is available
+        est = 60e-9 * npad * npad.bit_length() * pr * pc
+        if est > route_budget_s or rt._load() is None:
+            return plan
+    c2r = np.asarray(plan.c2r)            # (pr, pc, cap)
+    tiles = []
+    for i in range(pr):
+        for j in range(pc):
+            tiles.append(rt.plan_route_masks(c2r[i, j])[0])
+    masks = np.stack(tiles).reshape(pr, pc, *tiles[0].shape)
+    # device_put straight from numpy: resharding an already-committed
+    # array would stage the full mask tensor on one device first — an
+    # HBM spike at exactly the scales routing is for
+    masks = jax.device_put(
+        masks, a.grid.sharding(ROW_AXIS, COL_AXIS, None, None))
+    return dataclasses.replace(plan, route_masks=masks)
+
+
 def _caps(a: dm.DistSpMat) -> list[tuple[int, int]]:
     """Static (E, F) budget tiers for the sparse stepper, smallest
     first. Static shapes mean a sparse level pays its whole tier's
     gather cost even for a tiny frontier, so several tiers keep light
-    levels cheap while still covering frontiers up to ~cap/16 edges
-    (heavier frontiers take the dense full scan)."""
+    levels cheap. Budgets are ABSOLUTE, not cap-fractions: the sparse
+    stepper's cost is ~4 serialized accesses per slot (~65ns/slot
+    measured on v5e), so above ~256K slots the dense full scan wins
+    regardless of matrix size — a cap-relative tier on a single-chip
+    scale-22 tile would cost more than the scan it bypasses. Frontiers
+    too heavy for the largest tier take the dense stepper."""
     tiers = []
-    for div in (256, 64, 16):
-        e_cap = max(1024, (a.cap // div // 128) * 128)
+    for e_abs in (4096, 32768, 262144):
+        e_cap = max(1024, min(e_abs, (a.cap // 8 // 128) * 128))
         f_cap = max(128, min(a.tile_n, e_cap))
         tiers.append((e_cap, f_cap))
     return tiers
@@ -200,11 +251,17 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
     # so the frontier bits are instead (1) RLE-broadcast over the
     # column-sorted edge order — one tile_n-sized scatter plus a
     # segmented copy-scan, no random access — then (2) routed to row
-    # order by sorting against the static col→row key (~3x cheaper
-    # than the equivalent gather), then (3) max-scanned per row.
+    # order: through the precompiled Beneš bit network when the plan
+    # carries route masks (word-parallel delta-swaps on packed bits,
+    # ops/route.py), else by sorting against the static col→row key
+    # (~3x cheaper than the equivalent gather, but ~30x the traffic of
+    # the bit route), then (3) max-scanned per row.
+    use_route = plan.route_masks is not None
+    npad = plan.route_masks.shape[-1] * 32 if use_route else 0
+
     def dense_step(act):
         def f(cols_t, starts_t, valid_t, ends_m, nonempty, cstarts, cdeg,
-              crun_t, c2r, actb):
+              crun_t, c2r, rmasks, actb):
             cols_t, starts_t = cols_t[0, 0], starts_t[0, 0]
             valid_t, ends_m, nonempty = (valid_t[0, 0], ends_m[0, 0],
                                          nonempty[0, 0])
@@ -219,13 +276,19 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
             seed_t = tl.to_chunked(seed, fill=0)
             eact_c = tl.seg_scan_values(
                 S.MAX, seed_t, crun_t.reshape(chunk_len, 128))
-            # (2) route bits to row order: pack the frontier bit into
-            # the low bit of the (distinct) col->row key and sort ONE
-            # int32 array — half the sort payload of a (key, value)
-            # pair sort. cap <= 2^30 so the shift never overflows.
-            packed = (c2r << 1) | eact_c.T.reshape(-1)[:cap].astype(
-                jnp.int32)
-            eact_r = (lax.sort(packed) & 1).astype(jnp.int8)
+            # (2) bits from col order to row order
+            if use_route:
+                rp = rt.RoutePlan(rmasks[0, 0], cap, npad)
+                words = rt.pack_bits(eact_c.T.reshape(-1)[:cap], npad)
+                eact_r = rt.unpack_bits(rt.apply_route(rp, words), cap)
+            else:
+                # pack the frontier bit into the low bit of the
+                # (distinct) col->row key and sort ONE int32 array —
+                # half the sort payload of a (key, value) pair sort.
+                # cap <= 2^30 so the shift never overflows.
+                packed = (c2r << 1) | eact_c.T.reshape(-1)[:cap].astype(
+                    jnp.int32)
+                eact_r = (lax.sort(packed) & 1).astype(jnp.int8)
             # (3) per-row max-scan of parent candidates
             eb = tl.to_chunked(eact_r, fill=0).reshape(-1)
             e_act = (eb > 0) & valid_t
@@ -236,14 +299,18 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
                                   ends_m, nonempty)
             return lax.pmax(y, COL_AXIS)[None]
 
+        rmasks = (plan.route_masks if use_route else
+                  jnp.zeros((grid.pr, grid.pc, 1, 1), jnp.uint32))
         return jax.shard_map(
             f, mesh=mesh,
             in_specs=(spec3,) * 4 + (spec3, P(ROW_AXIS, COL_AXIS, None),
-                                     spec3, spec3, spec3, spec_act),
+                                     spec3, spec3, spec3,
+                                     P(ROW_AXIS, COL_AXIS, None, None),
+                                     spec_act),
             out_specs=spec_y,
         )(plan.cols_t, plan.starts_t, plan.valid_t, plan.ends_m,
           plan.nonempty, plan.cstarts, plan.cdeg, plan.crun_t, plan.c2r,
-          act)
+          rmasks, act)
 
     # ---- sparse stepper: frontier push with bounded scatter ---------------
     # Per expanded slot: 1 gather for the base offset, 2 for the edge
@@ -394,7 +461,9 @@ class BfsRunStats:
 def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
                  nroots: int = 16, seed: int = 1, cap_slack: float = 1.15,
                  validate: bool = False, validate_roots: int = 0,
-                 alpha: int = 8, verbose: bool = False) -> BfsRunStats:
+                 alpha: int = 8, route: bool | str = "auto",
+                 route_budget_s: float = 900.0,
+                 verbose: bool = False) -> BfsRunStats:
     """End-to-end Graph500 kernel-2 harness: generate R-MAT, build the
     symmetric adjacency matrix, run BFS from random roots, report TEPS
     (edges in the traversed component / time, per the reference's
@@ -419,8 +488,13 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     jax.block_until_ready(a.rows)
     if verbose:
         a.print_info("A")
-    plan = plan_bfs(a)
+    t_plan = time.perf_counter()
+    plan = plan_bfs(a, route=route, route_budget_s=route_budget_s)
     jax.block_until_ready(plan.crows)
+    if verbose:
+        routed = plan.route_masks is not None
+        print(f"plan: {time.perf_counter() - t_plan:.1f}s "
+              f"(route={'benes' if routed else 'sort'})")
 
     # degrees for root selection (roots must have degree > 0)
     deg = np.zeros(n, np.int64)

@@ -74,6 +74,23 @@ class Monoid:
     def identity(self, dtype) -> Array:
         return _identity_array(self.identity_value, dtype)
 
+    def identity_scalar(self, dtype):
+        """Identity as a PYTHON scalar (inf -> dtype extremum). Safe
+        to call inside jit/shard_map traces — unlike `identity`, which
+        stages a device constant — so kernels (e.g. the Pallas scan)
+        can bake it in as a compile-time literal."""
+        dtype = jnp.dtype(dtype)
+        v = self.identity_value
+        if v == _POS_INF and not jnp.issubdtype(dtype, jnp.floating):
+            return int(jnp.iinfo(dtype).max)
+        if v == _NEG_INF and not jnp.issubdtype(dtype, jnp.floating):
+            return int(jnp.iinfo(dtype).min)
+        if dtype == jnp.bool_:
+            return bool(v)
+        if jnp.issubdtype(dtype, jnp.integer):
+            return int(v)
+        return float(v)
+
     def fill(self, shape, dtype) -> Array:
         return jnp.full(shape, self.identity(dtype), dtype)
 

@@ -297,15 +297,14 @@ def seg_scan_core(monoid: Monoid, d2: Array, f2: Array):
 
 def seg_scan_values(monoid: Monoid, d2: Array, f2: Array) -> Array:
     """Values of the inclusive segmented scan over the chunk-column
-    layout. Dispatches to the single-pass Pallas kernel when enabled
-    (COMBBLAS_TPU_PALLAS=1 on a TPU backend — ops.pallas_kernels),
-    otherwise the XLA associative-scan reference path."""
+    layout. Dispatches to the single-pass Pallas kernel on TPU
+    backends (default on; COMBBLAS_TPU_PALLAS=0 disables —
+    ops.pallas_kernels), otherwise the XLA associative-scan reference
+    path."""
     from combblas_tpu.ops import pallas_kernels as pk
     if pk.enabled() and not pk.is_batched(d2):
-        import numpy as np
-        iv = np.asarray(monoid.identity(d2.dtype)).item()
         return pk.seg_scan_values(d2, f2, combine=monoid.combine,
-                                  ident_val=iv)
+                                  ident_val=monoid.identity_scalar(d2.dtype))
     return seg_scan_core(monoid, d2, f2)[0]
 
 

@@ -1,0 +1,40 @@
+"""Build-and-load helper for the native (C++) runtime pieces.
+
+The reference builds its C support libraries (mmio, graph500
+generator, usort) with CMake (CMakeLists.txt:115-124); here each
+single-file component compiles on first use with g++ into a _build/
+directory next to its source and loads via ctypes (no pybind11 in
+this environment). A missing toolchain degrades gracefully to None —
+callers fall back to their pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+
+
+def load_native(src: pathlib.Path, configure) -> ctypes.CDLL | None:
+    """Compile ``src`` (if not cached) and return the loaded CDLL with
+    ``configure(lib)`` applied; None when the toolchain is missing or
+    the build fails. The cache key is the source hash, so edits
+    rebuild automatically."""
+    try:
+        tag = hashlib.sha1(src.read_bytes()).hexdigest()[:12]
+        build = src.parent / "_build"
+        so = build / f"{src.stem}_{tag}.so"
+        if not so.exists():
+            build.mkdir(exist_ok=True)
+            tmp = so.with_suffix(f".{os.getpid()}.tmp")
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(tmp)],
+                check=True, capture_output=True, timeout=120)
+            tmp.replace(so)  # atomic: concurrent builders race safely
+        lib = ctypes.CDLL(str(so))
+        configure(lib)
+        return lib
+    except Exception:
+        return None

@@ -125,6 +125,36 @@ class TestStepperCrossCheck:
                 checked += 1
         assert checked >= 1, "no sparse tier fit this frontier; widen caps"
 
+    def test_routed_dense_matches_sort_dense(self, crosscheck_setup):
+        """The Beneš-routed dense stepper must be bit-identical to the
+        permute-by-sort dense stepper (same matrix, same frontier)."""
+        a, plan, n, tiers, steppers = crosscheck_setup
+        rplan = B.plan_bfs(a, route=True)
+        assert rplan.route_masks is not None
+        _, rsteppers = B.build_steppers(a, rplan)
+        rng = np.random.default_rng(1)
+        flat = rng.random(a.grid.pc * a.tile_n) < 0.2
+        flat[n:] = False
+        actj = jnp.asarray(flat.reshape(a.grid.pc, a.tile_n))
+        np.testing.assert_array_equal(
+            np.asarray(rsteppers[-1](actj)), np.asarray(steppers[-1](actj)))
+
+    def test_routed_bfs_validates(self, grid22):
+        """End-to-end routed BFS passes the Graph500 spec check."""
+        from combblas_tpu.ops import generate
+        n = 1 << 9
+        r, c = generate.rmat_edges(jax.random.key(5), 9, 6)
+        r, c = generate.symmetrize(r, c)
+        a = DM.from_global_coo(S.LOR, grid22, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        plan = B.plan_bfs(a, route=True)
+        rn, cn = np.asarray(r), np.asarray(c)
+        deg = np.zeros(n, np.int64)
+        np.add.at(deg, rn, 1)
+        root = int(np.nonzero(deg > 0)[0][0])
+        parents = np.asarray(B.bfs(a, jnp.int32(root), plan).to_global())
+        B.validate_bfs(rn, cn, n, root, parents)
+
     def test_tier_budgets_sane(self, crosscheck_setup):
         # budgets ascend (smallest tier first) and respect the floor;
         # at toy caps all tiers may clamp to the same floor — the

@@ -1,0 +1,63 @@
+"""Beneš static-permutation bit router (ops/route.py).
+
+The reference has no analogue — it scatters per edge inside OpenMP
+loops (Friends.h:64, BFSFriends.h:458); the router is the TPU-native
+replacement for that data movement. Golden model: direct numpy
+permutation application."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu.ops import route as R
+
+
+@pytest.mark.parametrize("n", [2, 5, 32, 64, 100, 1024, 5000, 1 << 14])
+def test_route_matches_numpy_permutation(rng, n):
+    perm = rng.permutation(n).astype(np.int32)
+    rp = R.plan_route(perm)
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = R.pack_bits(jnp.asarray(bits), rp.npad)
+    out = np.asarray(R.unpack_bits(R.apply_route(rp, words), n))
+    expect = np.zeros(n, np.int8)
+    expect[perm] = bits
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_pack_unpack_roundtrip(rng):
+    n = 1000
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    npad = 1 << 10
+    words = R.pack_bits(jnp.asarray(bits), npad)
+    assert words.dtype == jnp.uint32 and words.shape == (npad // 32,)
+    np.testing.assert_array_equal(
+        np.asarray(R.unpack_bits(words, n)), bits)
+
+
+def test_native_and_python_masks_agree(rng):
+    perm = rng.permutation(256).astype(np.int32)
+    lib = R._load()
+    if lib is None:
+        pytest.skip("native router unavailable")
+    native = np.asarray(R.plan_route(perm).masks)
+    py = R._benes_masks_py(perm)
+    np.testing.assert_array_equal(native, py)
+
+
+def test_identity_and_reversal(rng):
+    n = 512
+    for perm in (np.arange(n, dtype=np.int32),
+                 np.arange(n - 1, -1, -1, dtype=np.int32)):
+        rp = R.plan_route(perm)
+        bits = rng.integers(0, 2, n).astype(np.int8)
+        out = np.asarray(R.unpack_bits(
+            R.apply_route(rp, R.pack_bits(jnp.asarray(bits), rp.npad)), n))
+        expect = np.zeros(n, np.int8)
+        expect[perm] = bits
+        np.testing.assert_array_equal(out, expect)
+
+
+def test_rejects_non_permutation():
+    bad = np.array([0, 0, 1, 2] + list(range(4, 64)), np.int32)
+    with pytest.raises(ValueError):
+        R.plan_route(bad)
