@@ -102,11 +102,18 @@ def main():
             s = bench_bfs(args)
             break
         except Exception as e:          # noqa: BLE001 — report, don't die
-            last_err = e
             msg = str(e).lower()
+            last_err = str(e)
+            # the exception's traceback pins every frame local —
+            # including the failed run's device buffers; drop it all
+            # and collect BEFORE retrying at a smaller scale, or the
+            # retry inherits the OOM it is trying to escape
             oom = isinstance(e, MemoryError) or \
                 "resource_exhausted" in msg or "out of memory" in msg \
                 or "allocat" in msg
+            del e
+            import gc
+            gc.collect()
             if not oom:
                 break                    # deterministic bug: don't re-run
             args.scale -= 2

@@ -1,0 +1,205 @@
+"""Word-parallel segmented OR scans over packed bit vectors.
+
+Capability parity: the per-edge frontier/visited bookkeeping that the
+reference keeps in BitMap/BitMapFringe words (BitMap.h:1-168,
+BitMapFringe.h:41) and updates with word-level operations inside its
+bottom-up step (BFSFriends.h:458). TPU-native redesign: the BFS
+dense phase (models/bfs.py) keeps ALL per-edge state as 32x-packed
+bits and needs two primitives over them — an inclusive segmented OR
+scan (propagate "some neighbor is active" to each row's end slot) and
+its backward twin (fill the whole row run with the row's final bit).
+Both are Kogge-Stone prefix networks on (value, no-boundary) bit
+pairs: log2(n) stages of pure shift/AND/OR word arithmetic — no
+gather, no scatter, no per-element work.
+
+Layout: a bit vector of npad = 32 * nwords slots as (nwords,) uint32,
+little-endian bit order (bit i of word w = slot 32w + i), matching
+ops/route.py pack_bits. Segment STARTS are marked in a static packed
+flag vector (bit set = this slot begins a new segment).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _shift_up(x: jax.Array, d: int) -> jax.Array:
+    """Packed shift toward higher slot indices by d bits (zeros in);
+    slot i of the result = slot i-d of x."""
+    wd, bd = d // 32, d % 32
+    if wd:
+        x = jnp.concatenate([jnp.zeros((wd,), x.dtype), x[:-wd]])
+    if bd:
+        prev = jnp.concatenate([jnp.zeros((1,), x.dtype), x[:-1]])
+        x = (x << bd) | (prev >> (32 - bd))
+    return x
+
+
+def _shift_down(x: jax.Array, d: int) -> jax.Array:
+    """Packed shift toward lower slot indices: slot i = slot i+d of x."""
+    wd, bd = d // 32, d % 32
+    if wd:
+        x = jnp.concatenate([x[wd:], jnp.zeros((wd,), x.dtype)])
+    if bd:
+        nxt = jnp.concatenate([x[1:], jnp.zeros((1,), x.dtype)])
+        x = (x >> bd) | (nxt << (32 - bd))
+    return x
+
+
+def seg_or_scan_bits(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Inclusive segmented OR scan: out bit i = OR of x over
+    [segment_start(i), i]. ``x``/``starts``: (nwords,) uint32."""
+    n = int(x.shape[0]) * 32
+    y = x
+    nb = ~starts                      # "no boundary at this slot"
+    d = 1
+    while d < n:
+        y = y | (nb & _shift_up(y, d))
+        nb = nb & _shift_up(nb, d)
+        d <<= 1
+    return y
+
+
+def seg_or_fill_bits(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Segment-wide OR: out bit i = OR of x over i's WHOLE segment
+    (forward scan, then a backward OR-prefix blocked at starts — the
+    segment end's total flows down over every slot of its segment)."""
+    n = int(x.shape[0]) * 32
+    y = seg_or_scan_bits(x, starts)
+    nb = _shift_down(~starts, 1)      # no start in (i, i+1]
+    d = 1
+    while d < n:
+        y = y | (nb & _shift_down(y, d))
+        nb = nb & _shift_down(nb, d)  # no start in (i, i+2d]
+        d <<= 1
+    return y
+
+
+# --------------------------------------------------------------------------
+# Pallas fused kernel: both scans of seg_or_fill_bits in ONE grid step
+# with everything VMEM-resident — the Kogge-Stone stages are pure VPU
+# compute, so HBM traffic is just x + starts in, result out.
+# Works on the (R, 128) word layout (flat word w = (w // 128, w % 128)).
+# --------------------------------------------------------------------------
+
+def _rows_shift(x, k, down: bool):
+    """Shift rows of (R, 128) by k (zeros shifted in). down=True moves
+    row r-k's data to row r (toward higher flat order)."""
+    if k == 0:
+        return x
+    r = x.shape[0]
+    if k >= r:
+        return jnp.zeros_like(x)
+    pad = jnp.zeros((k, x.shape[1]), x.dtype)
+    return (jnp.concatenate([pad, x[:-k]], 0) if down
+            else jnp.concatenate([x[k:], pad], 0))
+
+
+def _lane_up(x, wd):
+    """Word shift toward higher flat index by wd words on (R, 128)."""
+    rs, ls = wd // 128, wd % 128
+    base = _rows_shift(x, rs, True)
+    if ls == 0:
+        return base
+    carry = _rows_shift(x, rs + 1, True)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, x.shape[1]), 1)
+    return jnp.where(lane >= ls, jnp.roll(base, ls, axis=1),
+                     jnp.roll(carry, ls, axis=1))
+
+
+def _lane_down(x, wd):
+    """Word shift toward lower flat index by wd words on (R, 128)."""
+    rs, ls = wd // 128, wd % 128
+    base = _rows_shift(x, rs, False)
+    if ls == 0:
+        return base
+    carry = _rows_shift(x, rs + 1, False)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, x.shape[1]), 1)
+    return jnp.where(lane < x.shape[1] - ls,
+                     jnp.roll(base, -ls, axis=1),
+                     jnp.roll(carry, -ls, axis=1))
+
+
+def _up2(x, d):
+    """Bit shift toward higher flat slot index by d on (R, 128)."""
+    wd, b = d // 32, d % 32
+    w = _lane_up(x, wd)
+    if b == 0:
+        return w
+    prev = _lane_up(x, wd + 1)
+    return (w << b) | (prev >> (32 - b))
+
+
+def _down2(x, d):
+    """Bit shift toward lower flat slot index by d on (R, 128)."""
+    wd, b = d // 32, d % 32
+    w = _lane_down(x, wd)
+    if b == 0:
+        return w
+    nxt = _lane_down(x, wd + 1)
+    return (w >> b) | (nxt << (32 - b))
+
+
+def _fill_kernel(x_ref, s_ref, o_ref, *, nbits):
+    x = x_ref[...]
+    s = s_ref[...]
+    y = x
+    nb = ~s
+    d = 1
+    while d < nbits:
+        y = y | (nb & _up2(y, d))
+        nb = nb & _up2(nb, d)
+        d <<= 1
+    nbd = _down2(~s, 1)
+    d = 1
+    while d < nbits:
+        y = y | (nbd & _down2(y, d))
+        nbd = nbd & _down2(nbd, d)
+        d <<= 1
+    o_ref[...] = y
+
+
+def seg_or_fill_pallas(x: jax.Array, starts: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """seg_or_fill_bits as one VMEM-resident Pallas step. ``x``,
+    ``starts``: (nwords,) uint32 with nwords a multiple of 128."""
+    import functools
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from combblas_tpu.ops.route import _sds
+
+    nwords = int(x.shape[0])
+    r = nwords // 128
+    kernel = functools.partial(_fill_kernel, nbits=nwords * 32)
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=_sds((r, 128), jnp.uint32, x),
+        interpret=interpret,
+    )(x.reshape(r, 128), starts.reshape(r, 128))
+    return out.reshape(-1)
+
+
+def seg_or_fill_best(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Dispatch: Pallas on TPU when the layout allows, else XLA."""
+    from combblas_tpu.ops import pallas_kernels as pk
+    if pk.enabled() and x.shape[0] % 128 == 0 and x.shape[0] >= 128:
+        return seg_or_fill_pallas(x, starts)
+    return seg_or_fill_bits(x, starts)
+
+
+def row_end_bits(y: jax.Array, starts: jax.Array, nbits: int) -> jax.Array:
+    """Bits of ``y`` at segment END slots (slot before the next start,
+    or the final valid slot), other slots zeroed. ``nbits`` = number
+    of live slots (the rest is padding). Used by the mesh variant of
+    the edge-space BFS, where per-tile row results must be extracted
+    to vertex space before the cross-tile OR (single-tile BFS stays
+    in edge space and never needs it)."""
+    nxt_start = _shift_down(starts, 1)
+    # the last live slot ends its segment too
+    w, b = (nbits - 1) // 32, (nbits - 1) % 32
+    lastmask = jnp.zeros_like(y).at[w].set(jnp.uint32(1 << b))
+    return y & (nxt_start | lastmask)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
+import functools
 import pathlib
 
 import jax
@@ -172,6 +173,100 @@ def _stride(t: int, m: int, npad: int) -> int:
     return npad >> (t + 1) if t < m else npad >> (2 * m - 1 - t)
 
 
+# --------------------------------------------------------------------------
+# Pallas application: the packed bit-vector stays resident in VMEM for
+# all 2*log2(npad)-1 stages; only the masks stream from HBM (one stage
+# per sequential grid step, double-buffered). HBM traffic drops from
+# ~3 arrays/stage (XLA) to ~1 mask/stage + one W read + one W write.
+# Delta-swaps are expressed as rolls (lane rolls for word-distance
+# < 128, sublane rolls above) — no reshapes, no Mosaic relayouts.
+# --------------------------------------------------------------------------
+
+def _stage_swap(e: int, w, mk):
+    """One Beneš stage at bit-stride 2^e on (R, 128) uint32 words.
+    Mask bits are set only at pair-lo positions, which makes the
+    roll-based pairing safe: rolled-in garbage lands where mask = 0."""
+    if e < 5:                      # within-word delta swap
+        s = 1 << e
+        delta = ((w >> s) ^ w) & mk
+        return w ^ delta ^ (delta << s)
+    if e < 12:                     # lane-dimension word swap
+        d = 1 << (e - 5)
+        p = jnp.roll(w, -d, axis=1)
+        delta = (w ^ p) & mk
+        return w ^ delta ^ jnp.roll(delta, d, axis=1)
+    d = 1 << (e - 12)              # sublane-dimension word swap
+    p = jnp.roll(w, -d, axis=0)
+    delta = (w ^ p) & mk
+    return w ^ delta ^ jnp.roll(delta, d, axis=0)
+
+
+def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages):
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        wscr[...] = w_ref[...]
+
+    w = wscr[...]
+    mk = m_ref[0]
+    k = jnp.abs(mexp - 1 - t)
+    w = lax.switch(k, [functools.partial(_stage_swap, e)
+                       for e in range(mexp)], w, mk)
+    wscr[...] = w
+
+    @pl.when(t == nstages - 1)
+    def _flush():
+        o_ref[...] = w
+
+
+def apply_route_pallas(rp: RoutePlan, words: jax.Array,
+                       interpret: bool = False) -> jax.Array:
+    """`apply_route` as a single Pallas kernel (TPU): W resident in
+    VMEM across all stages, masks streamed. Needs ~5x nwords x 4B of
+    VMEM — fine through npad = 2^27 on v5e (128 MB VMEM)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = rp.npad.bit_length() - 1
+    nstages = rp.nstages
+    nwords = rp.npad >> 5
+    r = max(nwords // 128, 1)
+    w2 = words.reshape(r, 128)
+    m3 = rp.masks.reshape(nstages, r, 128)
+    kernel = functools.partial(_route_kernel, mexp=m, nstages=nstages)
+    out = pl.pallas_call(
+        kernel,
+        grid=(nstages,),
+        in_specs=[
+            pl.BlockSpec((1, r, 128), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, 128), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, 128), lambda t: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((r, 128), jnp.uint32, words),
+        scratch_shapes=[pltpu.VMEM((r, 128), jnp.uint32)],
+        interpret=interpret,
+    )(m3, w2)
+    return out.reshape(-1)
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the caller's varying-mesh-axes set
+    (required for pallas_call under shard_map)."""
+    vma = getattr(getattr(like, "aval", None), "vma", None)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype,
+                                    vma=vma if vma is not None
+                                    else frozenset())
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def apply_route(rp: RoutePlan, words: jax.Array) -> jax.Array:
     """Route packed bit-words through the network: 2*log2(npad)-1
     word-parallel delta-swap stages.  ``words``: (npad/32,) uint32 as
@@ -192,6 +287,16 @@ def apply_route(rp: RoutePlan, words: jax.Array) -> jax.Array:
             delta = ((words >> s) ^ words) & mt
             words = words ^ delta ^ (delta << s)
     return words
+
+
+def apply_route_best(rp: RoutePlan, words: jax.Array) -> jax.Array:
+    """Route via the VMEM-resident Pallas kernel on TPU backends (when
+    the network is big enough for the (R, 128) layout), else the XLA
+    stage loop. Both are bit-identical."""
+    from combblas_tpu.ops import pallas_kernels as pk
+    if pk.enabled() and rp.npad >= (1 << 13):
+        return apply_route_pallas(rp, words)
+    return apply_route(rp, words)
 
 
 def pack_bits(bits: jax.Array, npad: int) -> jax.Array:

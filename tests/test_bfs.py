@@ -62,6 +62,36 @@ class TestBFS:
                                validate=True)
         assert len(stats.teps) == 3
 
+    def test_device_validator_matches_host(self):
+        """The on-device spec validator (the bench's 1x1 path) agrees
+        with the host validator, and rejects a corrupted tree."""
+        import jax
+        from combblas_tpu.ops import generate
+        grid = ProcGrid.make(1, 1, jax.devices()[:1])
+        n = 1 << 9
+        r, c = generate.rmat_edges(jax.random.key(11), 9, 6)
+        r, c = generate.symmetrize(r, c)
+        a = DM.from_global_coo(S.LOR, grid, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        plan = B.plan_bfs(a)
+        deg = B.row_degrees(a)
+        rn, cn = np.asarray(r), np.asarray(c)
+        root = int(rn[0])
+        parents = B.bfs(a, jnp.int32(root), plan)
+        info_d = B.validate_bfs_on_device(a, plan, root, parents, deg)
+        info_h = B.validate_bfs(rn, cn, n, root, parents.to_global())
+        assert info_d["visited"] == info_h["visited"]
+        assert info_d["depth"] == info_h["depth"]
+        # nedges may differ only by duplicate generator edges
+        assert info_d["nedges"] <= info_h["nedges"]
+        # corrupt the root's self-parent -> the validator must object
+        pg2 = np.asarray(parents.to_global()).copy()
+        pg2[root] = (root + 1) % n
+        bad = type(parents)(jnp.asarray(pg2).reshape(1, -1), a.grid,
+                            parents.axis, parents.glen)
+        with np.testing.assert_raises(AssertionError):
+            B.validate_bfs_on_device(a, plan, root, bad, deg)
+
 
 @pytest.fixture(scope="module")
 def crosscheck_setup(grid22):
@@ -154,6 +184,31 @@ class TestStepperCrossCheck:
         root = int(np.nonzero(deg > 0)[0][0])
         parents = np.asarray(B.bfs(a, jnp.int32(root), plan).to_global())
         B.validate_bfs(rn, cn, n, root, parents)
+
+    def test_bfs_bits_matches_bfs(self):
+        """The edge-space bit BFS (single-tile symmetric fast path)
+        produces spec-valid parents with the same visited set/levels
+        as the general stepper BFS."""
+        import jax
+        from combblas_tpu.ops import generate
+        grid = ProcGrid.make(1, 1, jax.devices()[:1])
+        for scale, ef, seed in ((9, 6, 3), (11, 4, 5)):
+            n = 1 << scale
+            r, c = generate.rmat_edges(jax.random.key(seed), scale, ef)
+            r, c = generate.symmetrize(r, c)
+            a = DM.from_global_coo(S.LOR, grid, r, c,
+                                   jnp.ones_like(r, jnp.bool_), n, n)
+            plan = B.plan_bfs(a, route=True)
+            deg = B.row_degrees(a)
+            rn, cn = np.asarray(r), np.asarray(c)
+            root = int(rn[0])
+            pa = B.bfs(a, jnp.int32(root), B.plan_bfs(a))
+            pb = B.bfs_bits(a, jnp.int32(root), plan)
+            ga, gb = np.asarray(pa.to_global()), np.asarray(pb.to_global())
+            # same visited set; parents may differ but must be valid
+            np.testing.assert_array_equal(ga >= 0, gb >= 0)
+            B.validate_bfs(rn, cn, n, root, gb)
+            B.validate_bfs_on_device(a, plan, root, pb, deg)
 
     def test_tier_budgets_sane(self, crosscheck_setup):
         # budgets ascend (smallest tier first) and respect the floor;

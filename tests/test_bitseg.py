@@ -1,0 +1,68 @@
+"""Packed-bit segmented OR scans (ops/bitseg.py) vs a direct numpy
+segment model — the primitives under the edge-space BFS dense phase
+(≅ the reference's BitMap word machinery, BitMap.h, BFSFriends.h:458)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu.ops import bitseg as BS
+from combblas_tpu.ops import route as R
+
+
+def _segments(starts_bool):
+    seg = np.cumsum(starts_bool.astype(np.int64)) - 1
+    return seg
+
+
+def _pack(bits, npad):
+    return R.pack_bits(jnp.asarray(bits.astype(np.int8)), npad)
+
+
+@pytest.mark.parametrize("n,p", [(96, 0.3), (1000, 0.1), (4096, 0.02),
+                                 (5000, 0.5)])
+def test_seg_or_scan_matches_numpy(rng, n, p):
+    npad = 1 << max(5, (n - 1).bit_length())
+    x = rng.random(n) < 0.2
+    starts = rng.random(n) < p
+    starts[0] = True
+    xp = np.zeros(npad, bool)
+    xp[:n] = x
+    sp = np.zeros(npad, bool)
+    sp[:n] = starts
+    sp[n:] = True    # padding slots are their own segments
+    seg = _segments(sp)
+    expect_scan = np.zeros(npad, bool)
+    acc = False
+    for i in range(npad):
+        acc = xp[i] if sp[i] else (acc or xp[i])
+        expect_scan[i] = acc
+    got = np.asarray(R.unpack_bits(
+        BS.seg_or_scan_bits(_pack(xp, npad), _pack(sp, npad)), npad))
+    np.testing.assert_array_equal(got.astype(bool), expect_scan)
+
+    expect_fill = np.zeros(npad, bool)
+    for s in range(seg[-1] + 1):
+        m = seg == s
+        expect_fill[m] = xp[m].any()
+    gotf = np.asarray(R.unpack_bits(
+        BS.seg_or_fill_bits(_pack(xp, npad), _pack(sp, npad)), npad))
+    np.testing.assert_array_equal(gotf.astype(bool), expect_fill)
+
+    if npad >= 4096:   # (R, 128) layout exists: check the Pallas twin
+        gotp = np.asarray(R.unpack_bits(
+            BS.seg_or_fill_pallas(_pack(xp, npad), _pack(sp, npad),
+                                  interpret=True), npad))
+        np.testing.assert_array_equal(gotp.astype(bool), expect_fill)
+
+    # end-slot extraction: the scan value survives only at segment ends
+    live_ends = np.zeros(npad, bool)
+    for i in range(n):
+        if i == n - 1 or sp[i + 1]:
+            live_ends[i] = True
+    expect_ends = expect_scan & live_ends
+    gote = np.asarray(R.unpack_bits(
+        BS.row_end_bits(BS.seg_or_scan_bits(_pack(xp, npad),
+                                            _pack(sp, npad)),
+                        _pack(sp, npad), n), npad))
+    np.testing.assert_array_equal(gote.astype(bool), expect_ends)
