@@ -83,6 +83,24 @@ def seg_or_fill_bits(x: jax.Array, starts: jax.Array) -> jax.Array:
 # Works on the (R, 128) word layout (flat word w = (w // 128, w % 128)).
 # --------------------------------------------------------------------------
 
+def _roll(x, shift, axis):
+    """Rotate, preferring the hardware roll inside Mosaic kernels —
+    concatenate-based shifts make Mosaic compile time explode with the
+    sublane extent (hours at 2^27 slots), a single tpu rotate stays
+    flat."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        roll = pltpu.roll
+    except (ImportError, AttributeError):   # API drift: make it LOUD
+        raise RuntimeError(
+            "pltpu.roll disappeared from this JAX version; the blocked "
+            "bit kernels depend on the hardware roll (concatenate-based "
+            "shifts take Mosaic hours to compile at 2^26+ slots)")
+    if isinstance(shift, int) and shift < 0:
+        shift += x.shape[axis]      # pltpu.roll wants non-negative
+    return roll(x, shift, axis)
+
+
 def _rows_shift(x, k, down: bool):
     """Shift rows of (R, 128) by k (zeros shifted in). down=True moves
     row r-k's data to row r (toward higher flat order)."""
@@ -91,9 +109,10 @@ def _rows_shift(x, k, down: bool):
     r = x.shape[0]
     if k >= r:
         return jnp.zeros_like(x)
-    pad = jnp.zeros((k, x.shape[1]), x.dtype)
-    return (jnp.concatenate([pad, x[:-k]], 0) if down
-            else jnp.concatenate([x[k:], pad], 0))
+    row = jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], 1), 0)
+    if down:
+        return jnp.where(row >= k, _roll(x, k, 0), 0)
+    return jnp.where(row < r - k, _roll(x, -k, 0), 0)
 
 
 def _lane_up(x, wd):
@@ -104,8 +123,8 @@ def _lane_up(x, wd):
         return base
     carry = _rows_shift(x, rs + 1, True)
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, x.shape[1]), 1)
-    return jnp.where(lane >= ls, jnp.roll(base, ls, axis=1),
-                     jnp.roll(carry, ls, axis=1))
+    return jnp.where(lane >= ls, _roll(base, ls, 1),
+                     _roll(carry, ls, 1))
 
 
 def _lane_down(x, wd):
@@ -117,8 +136,8 @@ def _lane_down(x, wd):
     carry = _rows_shift(x, rs + 1, False)
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, x.shape[1]), 1)
     return jnp.where(lane < x.shape[1] - ls,
-                     jnp.roll(base, -ls, axis=1),
-                     jnp.roll(carry, -ls, axis=1))
+                     _roll(base, -ls, 1),
+                     _roll(carry, -ls, 1))
 
 
 def _up2(x, d):
@@ -141,29 +160,83 @@ def _down2(x, d):
     return (w >> b) | (nxt << (32 - b))
 
 
-def _fill_kernel(x_ref, s_ref, o_ref, *, nbits):
+_BLR = 512     # rows per streamed block: keeps every in-kernel roll
+#                distance small so Mosaic compile time stays flat in the
+#                total size (full-array rolls at 2^27 slots took Mosaic
+#                over an hour; blocked kernels compile in seconds)
+
+
+def _block_or_scan(x, s, nbits_blk, up: bool):
+    """In-block segmented OR scan (inclusive) plus the block's
+    carry-admission mask M (bit i set = no segment boundary between
+    the block's entry edge and slot i). up=False is the mirrored
+    backward scan (entry edge = the block's last slot)."""
+    shift = _up2 if up else _down2
+    y = x
+    nb = ~s if up else shift(~s, 1)
+    d = 1
+    while d < nbits_blk:
+        y = y | (nb & shift(y, d))
+        nb = nb & shift(nb, d)
+        d <<= 1
+    # forward: a start AT slot i blocks the incoming carry at i;
+    # backward: a start at slot i+1 blocks carry descending into i
+    blockers = s if up else shift(s, 1)
+    cov = blockers
+    d = 1
+    while d < nbits_blk:
+        cov = cov | shift(cov, d)
+        d <<= 1
+    return y, ~cov
+
+
+def _fill_fwd_kernel(x_ref, s_ref, o_ref, carry_ref, *, nbits_blk):
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
     x = x_ref[...]
     s = s_ref[...]
-    y = x
-    nb = ~s
-    d = 1
-    while d < nbits:
-        y = y | (nb & _up2(y, d))
-        nb = nb & _up2(nb, d)
-        d <<= 1
-    nbd = _down2(~s, 1)
-    d = 1
-    while d < nbits:
-        y = y | (nbd & _down2(y, d))
-        nbd = nbd & _down2(nbd, d)
-        d <<= 1
+    y, m = _block_or_scan(x, s, nbits_blk, up=True)
+
+    @pl.when(t == 0)
+    def _init():
+        carry_ref[...] = jnp.zeros_like(carry_ref)
+
+    y = y | (m & carry_ref[0, 0])
     o_ref[...] = y
+    last = y[-1:, -1:] >> 31               # bit 31 of the final word
+    carry_ref[...] = jnp.where(last > 0, jnp.uint32(0xFFFFFFFF),
+                               jnp.uint32(0))
+
+
+def _fill_bwd_kernel(y_ref, s_ref, o_ref, carry_ref, *, nbits_blk):
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+    y0 = y_ref[...]
+    s = s_ref[...]
+    y, m = _block_or_scan(y0, s, nbits_blk, up=False)
+
+    @pl.when(t == 0)
+    def _init():
+        carry_ref[...] = jnp.zeros_like(carry_ref)
+
+    y = y | (m & carry_ref[0, 0])
+    o_ref[...] = y
+    # carry down across the boundary: the first slot's value, unless
+    # that slot itself starts a segment
+    first = (y[0:1, 0:1] & ~s[0:1, 0:1]) & jnp.uint32(1)
+    carry_ref[...] = jnp.where(first > 0, jnp.uint32(0xFFFFFFFF),
+                               jnp.uint32(0))
 
 
 def seg_or_fill_pallas(x: jax.Array, starts: jax.Array,
                        interpret: bool = False) -> jax.Array:
-    """seg_or_fill_bits as one VMEM-resident Pallas step. ``x``,
-    ``starts``: (nwords,) uint32 with nwords a multiple of 128."""
+    """seg_or_fill_bits as two block-streamed Pallas passes: forward
+    segmented scan, then the backward fill with the grid iterated in
+    reverse block order (the index_map flips). A (1, 1) carry word in
+    scratch stitches blocks. ``x``, ``starts``: (nwords,) uint32 with
+    nwords a multiple of 128."""
     import functools
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -171,16 +244,49 @@ def seg_or_fill_pallas(x: jax.Array, starts: jax.Array,
 
     nwords = int(x.shape[0])
     r = nwords // 128
-    kernel = functools.partial(_fill_kernel, nbits=nwords * 32)
-    out = pl.pallas_call(
-        kernel,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=_sds((r, 128), jnp.uint32, x),
+    blr = min(_BLR, r)
+    nblk = -(-r // blr)
+    padr = nblk * blr
+    x2 = x.reshape(r, 128)
+    s2 = starts.reshape(r, 128)
+    if padr != r:
+        # pad with self-segmenting empty slots (start=1, x=0): inert
+        x2 = jnp.pad(x2, ((0, padr - r), (0, 0)))
+        s2 = jnp.pad(s2, ((0, padr - r), (0, 0)),
+                     constant_values=jnp.uint32(0xFFFFFFFF))
+    nbits_blk = blr * 128 * 32
+
+    fwd = pl.pallas_call(
+        functools.partial(_fill_fwd_kernel, nbits_blk=nbits_blk),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((padr, 128), jnp.uint32, x),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
         interpret=interpret,
-    )(x.reshape(r, 128), starts.reshape(r, 128))
-    return out.reshape(-1)
+    )(x2, s2)
+
+    bwd = pl.pallas_call(
+        functools.partial(_fill_bwd_kernel, nbits_blk=nbits_blk),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((blr, 128),
+                               lambda t, n=nblk: (n - 1 - t, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((blr, 128),
+                               lambda t, n=nblk: (n - 1 - t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((blr, 128),
+                               lambda t, n=nblk: (n - 1 - t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((padr, 128), jnp.uint32, x),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(fwd, s2)
+    return bwd[:r].reshape(-1)
 
 
 def seg_or_fill_best(x: jax.Array, starts: jax.Array) -> jax.Array:

@@ -186,40 +186,90 @@ def _stage_swap(e: int, w, mk):
     """One Beneš stage at bit-stride 2^e on (R, 128) uint32 words.
     Mask bits are set only at pair-lo positions, which makes the
     roll-based pairing safe: rolled-in garbage lands where mask = 0."""
+    from combblas_tpu.ops.bitseg import _roll
     if e < 5:                      # within-word delta swap
         s = 1 << e
         delta = ((w >> s) ^ w) & mk
         return w ^ delta ^ (delta << s)
     if e < 12:                     # lane-dimension word swap
         d = 1 << (e - 5)
-        p = jnp.roll(w, -d, axis=1)
+        p = _roll(w, -d, 1)
         delta = (w ^ p) & mk
-        return w ^ delta ^ jnp.roll(delta, d, axis=1)
+        return w ^ delta ^ _roll(delta, d, 1)
     d = 1 << (e - 12)              # sublane-dimension word swap
-    p = jnp.roll(w, -d, axis=0)
+    p = _roll(w, -d, 0)
     delta = (w ^ p) & mk
-    return w ^ delta ^ jnp.roll(delta, d, axis=0)
+    return w ^ delta ^ _roll(delta, d, 0)
 
 
-def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages):
+_RBLR = 512    # strip rows for the route kernel: every stage either
+#               keeps its swap pairs inside one aligned strip (bit,
+#               lane, and small row strides — powers of two never
+#               straddle aligned power-of-two strips) or pairs whole
+#               strips; full-array vector ops are avoided because
+#               Mosaic compile time explodes with the sublane extent
+
+
+def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr):
     import jax.experimental.pallas as pl
 
     t = pl.program_id(0)
+    r = wscr.shape[0]
+    nstrips = r // blr
+    k = jnp.abs(mexp - 1 - t)
 
     @pl.when(t == 0)
     def _init():
-        wscr[...] = w_ref[...]
+        def body(i, _):
+            rows = pl.ds(i * blr, blr)
+            wscr[rows, :] = w_ref[rows, :]
+            return 0
 
-    w = wscr[...]
-    mk = m_ref[0]
-    k = jnp.abs(mexp - 1 - t)
-    w = lax.switch(k, [functools.partial(_stage_swap, e)
-                       for e in range(mexp)], w, mk)
-    wscr[...] = w
+        lax.fori_loop(0, nstrips, body, 0)
+
+    for e in range(mexp):
+        # bit (e<5) and lane (e<12) strides stay within a row; row
+        # strides 2^(e-12) stay within an aligned strip iff the pair
+        # block 2*2^(e-12) fits it
+        in_strip = e < 12 or 2 * (1 << (e - 12)) <= blr
+        if in_strip or nstrips == 1:
+            @pl.when(k == e)
+            def _small(e=e):
+                def body(i, _):
+                    rows = pl.ds(i * blr, blr)
+                    a = wscr[rows, :]
+                    mk = m_ref[0, rows, :]
+                    wscr[rows, :] = _stage_swap(e, a, mk)
+                    return 0
+
+                lax.fori_loop(0, nstrips, body, 0)
+        else:
+            @pl.when(k == e)
+            def _big(e=e):
+                step = (1 << (e - 12)) // blr   # strips between pair
+                def body(i, _):
+                    blk, off = i // step, i % step
+                    lo = blk * 2 * step + off
+                    rlo = pl.ds(lo * blr, blr)
+                    rhi = pl.ds((lo + step) * blr, blr)
+                    a = wscr[rlo, :]
+                    b = wscr[rhi, :]
+                    mk = m_ref[0, rlo, :]
+                    delta = (a ^ b) & mk
+                    wscr[rlo, :] = a ^ delta
+                    wscr[rhi, :] = b ^ delta
+                    return 0
+
+                lax.fori_loop(0, nstrips // 2, body, 0)
 
     @pl.when(t == nstages - 1)
     def _flush():
-        o_ref[...] = w
+        def body(i, _):
+            rows = pl.ds(i * blr, blr)
+            o_ref[rows, :] = wscr[rows, :]
+            return 0
+
+        lax.fori_loop(0, nstrips, body, 0)
 
 
 def apply_route_pallas(rp: RoutePlan, words: jax.Array,
@@ -236,7 +286,8 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
     r = max(nwords // 128, 1)
     w2 = words.reshape(r, 128)
     m3 = rp.masks.reshape(nstages, r, 128)
-    kernel = functools.partial(_route_kernel, mexp=m, nstages=nstages)
+    kernel = functools.partial(_route_kernel, mexp=m, nstages=nstages,
+                               blr=min(_RBLR, r))
     out = pl.pallas_call(
         kernel,
         grid=(nstages,),
@@ -250,9 +301,19 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
                                memory_space=pltpu.VMEM),
         out_shape=_sds((r, 128), jnp.uint32, words),
         scratch_shapes=[pltpu.VMEM((r, 128), jnp.uint32)],
+        compiler_params=_vmem_params(),
         interpret=interpret,
     )(m3, w2)
     return out.reshape(-1)
+
+
+def _vmem_params():
+    """Raise the scoped-VMEM ceiling: the resident-W kernels hold
+    several full word arrays (default limit is 16 MB; v5e has 128)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(vmem_limit_bytes=112 * 1024 * 1024)
 
 
 def _sds(shape, dtype, like):
@@ -294,7 +355,10 @@ def apply_route_best(rp: RoutePlan, words: jax.Array) -> jax.Array:
     the network is big enough for the (R, 128) layout), else the XLA
     stage loop. Both are bit-identical."""
     from combblas_tpu.ops import pallas_kernels as pk
-    if pk.enabled() and rp.npad >= (1 << 13):
+    # VMEM budget: W in+out+scratch + double-buffered mask stream =
+    # 5 x npad/8 bytes; v5e VMEM is 128 MB, so 2^27 slots is the
+    # largest resident network
+    if pk.enabled() and (1 << 13) <= rp.npad <= (1 << 27):
         return apply_route_pallas(rp, words)
     return apply_route(rp, words)
 

@@ -19,6 +19,50 @@ def _pack(bits, npad):
     return R.pack_bits(jnp.asarray(bits.astype(np.int8)), npad)
 
 
+def test_fill_pallas_multiblock_carry(rng):
+    """Segments crossing the streamed-block boundary must be stitched
+    by the carry word (blocks are 512x128 words; use a graph-sized
+    vector with block-straddling runs)."""
+    from combblas_tpu.ops import bitseg as BS2
+    npad = BS2._BLR * 128 * 32 * 2          # exactly 2 blocks
+    n = npad
+    starts = np.zeros(n, bool)
+    # long runs, several straddling the block boundary
+    for pos in range(0, n, 997_001):
+        starts[pos] = True
+    starts[0] = True
+    x = np.zeros(n, bool)
+    x[::1_003_003] = True                    # sparse set bits
+    seg = np.cumsum(starts) - 1
+    expect = np.zeros(n, bool)
+    for sid in np.unique(seg[np.nonzero(x)[0]]):
+        expect[seg == sid] = True
+    got = np.asarray(R.unpack_bits(
+        BS2.seg_or_fill_pallas(_pack(x, npad), _pack(starts, npad),
+                               interpret=True), npad))
+    np.testing.assert_array_equal(got.astype(bool), expect)
+
+
+def test_fill_pallas_pad_path(rng):
+    """nwords a multiple of 128 but rows not a multiple of the block:
+    the pad rows must stay inert (self-segmenting starts, zero data)
+    and not corrupt the backward carry into the last real block."""
+    from combblas_tpu.ops import bitseg as BS2
+    r = 640                                   # 1 full block + 128 rows
+    npad = r * 128 * 32
+    starts = np.zeros(npad, bool)
+    starts[0] = True
+    starts[npad // 2] = True                  # one boundary mid-array
+    x = np.zeros(npad, bool)
+    x[npad - 1] = True                        # only the LAST slot set
+    got = np.asarray(R.unpack_bits(
+        BS2.seg_or_fill_pallas(_pack(x, npad), _pack(starts, npad),
+                               interpret=True), npad))
+    expect = np.zeros(npad, bool)
+    expect[npad // 2:] = True                 # whole second segment
+    np.testing.assert_array_equal(got.astype(bool), expect)
+
+
 @pytest.mark.parametrize("n,p", [(96, 0.3), (1000, 0.1), (4096, 0.02),
                                  (5000, 0.5)])
 def test_seg_or_scan_matches_numpy(rng, n, p):

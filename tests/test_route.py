@@ -74,6 +74,20 @@ def test_pallas_route_matches_xla(rng):
         np.asarray(R.unpack_bits(jnp.asarray(got), n)), expect)
 
 
+def test_pallas_route_strip_pair_branch(rng):
+    """npad >= 2^22 engages the strip-pair (`_big`) stages of the
+    route kernel — the production path at benchmark scale; guard its
+    pair-index math against regressions (interpret mode)."""
+    n = 1 << 22
+    perm = rng.permutation(n).astype(np.int32)
+    rp = R.plan_route(perm)
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = R.pack_bits(jnp.asarray(bits), rp.npad)
+    ref = np.asarray(R.apply_route(rp, words))
+    got = np.asarray(R.apply_route_pallas(rp, words, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_rejects_non_permutation():
     bad = np.array([0, 0, 1, 2] + list(range(4, 64)), np.int32)
     with pytest.raises(ValueError):
