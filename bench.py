@@ -78,11 +78,12 @@ def main():
                     help="Graph500 recipe: 64 random roots")
     ap.add_argument("--validate-roots", type=int, default=1,
                     help="spec-validate this many roots (untimed)")
-    ap.add_argument("--spgemm-scale", type=int, default=16,
-                    help="A*A benchmark scale (largest feasible "
-                         "single-chip; baseline metric names scale 22 — "
-                         "the JSON states the actual scale)")
-    ap.add_argument("--phase-flop-budget", type=int, default=2 ** 27)
+    ap.add_argument("--spgemm-scale", type=int, default=14,
+                    help="A*A benchmark scale (largest single-chip scale "
+                         "that fits the 16 GB HBM with phased expansion; "
+                         "baseline metric names scale 22 — the JSON "
+                         "states the actual scale)")
+    ap.add_argument("--phase-flop-budget", type=int, default=2 ** 24)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--skip-spgemm", action="store_true")
     ap.add_argument("--verbose", action="store_true")

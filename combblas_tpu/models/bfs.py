@@ -164,7 +164,7 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
     tiles = []
     for i in range(pr):
         for j in range(pc):
-            tiles.append(rt.plan_route_masks(c2r[i, j])[0])
+            tiles.append(_cached_route_masks(c2r[i, j]))
     masks = np.stack(tiles).reshape(pr, pc, *tiles[0].shape)
     # device_put straight from numpy: resharding an already-committed
     # array would stage the full mask tensor on one device first — an
@@ -175,6 +175,39 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
     sb, vb, rs = _bit_structure(a, npad_r)
     return dataclasses.replace(plan, route_masks=masks, starts_bits=sb,
                                valid_bits=vb, rstarts=rs)
+
+
+def _cached_route_masks(c2r_tile: np.ndarray) -> np.ndarray:
+    """plan_route_masks with a host disk cache keyed by the
+    permutation's content hash: Beneš planning is minutes of one-core
+    work at bench scales, and repeated runs on the same generated
+    graph (fixed seed) rebuild the identical permutation.
+    COMBBLAS_TPU_ROUTE_CACHE overrides the location; empty disables."""
+    import hashlib
+    import os
+    import pathlib
+
+    cdir = os.environ.get("COMBBLAS_TPU_ROUTE_CACHE",
+                          "/tmp/combblas_route_cache")
+    if not cdir:
+        return rt.plan_route_masks(c2r_tile)[0]
+    key = hashlib.sha1(np.ascontiguousarray(c2r_tile).view(
+        np.uint8)).hexdigest()[:20]
+    path = pathlib.Path(cdir) / f"benes_{key}_{len(c2r_tile)}.npy"
+    if path.exists():
+        try:
+            return np.load(path)
+        except Exception:
+            pass                       # corrupt cache entry: recompute
+    masks = rt.plan_route_masks(c2r_tile)[0]
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f"{path.stem}.{os.getpid()}.npy")
+        np.save(tmp, masks)
+        tmp.replace(path)
+    except Exception:
+        pass                           # cache is best-effort only
+    return masks
 
 
 @partial(jax.jit, static_argnames=("npad",))

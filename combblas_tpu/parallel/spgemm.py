@@ -225,12 +225,21 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                      a.tile_m, b.tile_n)
 
 
+def _bucket_cap(x: int, floor: int) -> int:
+    """Round a dynamic capacity up to a power of two (>= floor): caps
+    become coarse compile-shape buckets, so the phases of a budgeted
+    multiply hit the jit cache instead of compiling one SUMMA per
+    phase (~1 min of remote compile EACH; dozens of phases also drove
+    the TPU compile helper out of memory)."""
+    return 1 << max(floor.bit_length() - 1, (max(x, 1) - 1).bit_length())
+
+
 def _planned_summa(sr: Semiring, a: DistSpMat, b: DistSpMat,
                    cap_round: int, what: str) -> DistSpMat:
-    """plan + round caps (for compile reuse) + saturation guard + summa."""
+    """plan + bucket caps (for compile reuse) + saturation guard + summa."""
     fc, oc = plan_spgemm(a, b)
-    fc = -(-fc // cap_round) * cap_round
-    oc = -(-oc // cap_round) * cap_round
+    fc = _bucket_cap(fc, cap_round)
+    oc = _bucket_cap(oc, cap_round)
     if fc > _SAT:
         raise ValueError(
             f"{what} needs a {fc}-slot expansion (> 2^30); "
@@ -267,8 +276,9 @@ def _col_window(b: DistSpMat, lo: int, w: int) -> DistSpMat:
                         b.vals.reshape(-1, cap), b.nnz.reshape(-1))
     # col_slice compacts live entries to the front, so truncating to the
     # observed max nnz (one host sync per phase, in the host-side phase
-    # loop anyway) is lossless
-    wcap = min(cap, max(128, -(-int(np.asarray(out.nnz).max()) // 128) * 128))
+    # loop anyway) is lossless; power-of-two buckets keep every phase
+    # in the same compiled SUMMA (see _bucket_cap)
+    wcap = min(cap, _bucket_cap(int(np.asarray(out.nnz).max()), 128))
     return DistSpMat(out.rows[:, :wcap].reshape(pr, pc, wcap),
                      out.cols[:, :wcap].reshape(pr, pc, wcap),
                      out.vals[:, :wcap].reshape(pr, pc, wcap),
@@ -329,6 +339,13 @@ def phase_loop(a: DistSpMat, b: DistSpMat, multiply_window, *,
         if prune_hook is not None:
             cp = prune_hook(cp)
         parts.append(cp)
+        if len(parts) >= 6:
+            # bound peak memory: many-phase runs (budgeted MCL
+            # expansions, the A*A bench) must not hold every window's
+            # padded tiles at once — fold finished windows into one
+            # running wide part (window offsets stay consistent
+            # because col_concat shifts by cumulative widths)
+            parts = [_concat_parts(a, parts, cap_round, None)]
     return concat_col_windows(a, b, parts, cap_round, out_cap)
 
 
@@ -339,6 +356,15 @@ def concat_col_windows(a: DistSpMat, b: DistSpMat, parts: list,
     phases, in window order) back into full-width C tiles (≅
     ColConcatenate). A user-supplied out_cap must hold every surviving
     entry (no silent dropping — from_global_coo's contract)."""
+    out = _concat_parts(a, parts, cap_round, out_cap)
+    return DistSpMat(out.rows, out.cols, out.vals, out.nnz, a.grid,
+                     a.nrows, b.ncols, a.tile_m, b.tile_n)
+
+
+def _concat_parts(a: DistSpMat, parts: list, cap_round: int,
+                  out_cap: Optional[int]) -> DistSpMat:
+    """Column-concatenate window parts; the result's width is the sum
+    of the parts' widths (callers spanning all of B fix up ncols)."""
     need = int(np.asarray(sum(np.asarray(p.nnz, np.int64)
                               for p in parts)).max())
     if out_cap is None:
@@ -366,6 +392,7 @@ def concat_col_windows(a: DistSpMat, b: DistSpMat, parts: list,
                  part.nnz.reshape(-1)]
     out = jax.vmap(cat)(*args)
     oc = out.rows.shape[-1]
+    width = sum(part.tile_n for part in parts)
     shard3 = a.grid.sharding(ROW_AXIS, COL_AXIS, None)
     shard2 = a.grid.sharding(ROW_AXIS, COL_AXIS)
     return DistSpMat(
@@ -373,7 +400,7 @@ def concat_col_windows(a: DistSpMat, b: DistSpMat, parts: list,
         jax.device_put(out.cols.reshape(pr, pc, oc), shard3),
         jax.device_put(out.vals.reshape(pr, pc, oc), shard3),
         jax.device_put(out.nnz.reshape(pr, pc), shard2),
-        a.grid, a.nrows, b.ncols, a.tile_m, b.tile_n)
+        a.grid, a.nrows, pc * width, a.tile_m, width)
 
 
 def block_spgemm(sr: Semiring, a: DistSpMat, b: DistSpMat,

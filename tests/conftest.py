@@ -35,6 +35,20 @@ def devices():
     return devs
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _drop_compiled_state():
+    """Clear jit caches between test modules: a full-suite run
+    accumulates hundreds of compiled executables on the 8-device CPU
+    backend, which has twice ended in a SIGSEGV deep inside XLA CPU
+    around the ~150-test mark (different test each time). Dropping
+    executables per module keeps the backend state small; compile
+    reuse within a module — where it matters for speed — is kept."""
+    yield
+    import gc
+    jax.clear_caches()
+    gc.collect()
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

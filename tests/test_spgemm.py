@@ -99,7 +99,8 @@ class TestPhased:
         db = random_sparse(rng, n, n, 0.4)
         a = DM.from_dense(S.PLUS, grid24, da, 0.0)
         b = DM.from_dense(S.PLUS, grid24, db, 0.0)
-        for phases in (2, 3):
+        for phases in (2, 3, 8):  # 8 exercises the
+            # mid-loop consolidation (parts folded every 6 windows)
             c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, b, phases=phases)
             np.testing.assert_allclose(DM.to_dense(c, 0.0), da @ db,
                                        rtol=1e-5, err_msg=f"phases={phases}")
