@@ -76,14 +76,16 @@ def main():
     ap.add_argument("--edgefactor", type=int, default=16)
     ap.add_argument("--nroots", type=int, default=64,
                     help="Graph500 recipe: 64 random roots")
-    ap.add_argument("--validate-roots", type=int, default=1,
-                    help="spec-validate this many roots (untimed)")
-    ap.add_argument("--spgemm-scale", type=int, default=14,
+    ap.add_argument("--validate-roots", type=int, default=8,
+                    help="spec-validate this many roots (untimed; the "
+                         "on-device validator makes >= 8 cheap)")
+    ap.add_argument("--spgemm-scale", type=int, default=16,
                     help="A*A benchmark scale (largest single-chip scale "
-                         "that fits the 16 GB HBM with phased expansion; "
-                         "baseline metric names scale 22 — the JSON "
-                         "states the actual scale)")
-    ap.add_argument("--phase-flop-budget", type=int, default=2 ** 24)
+                         "whose full C fits the 16 GB HBM; baseline "
+                         "metric names scale 22 — the JSON states the "
+                         "actual scale; scale 18+ needs the streaming "
+                         "block_spgemm driver, scripts/spgemm_stream.py)")
+    ap.add_argument("--phase-flop-budget", type=int, default=2 ** 26)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--skip-spgemm", action="store_true")
     ap.add_argument("--verbose", action="store_true")

@@ -36,12 +36,22 @@ def rmat_edges(key: Array, scale: int, edgefactor: int = 16,
     n = 1 << scale
     m = edgefactor << scale
     kperm, key = jax.random.split(key)
+    rows, cols = _rmat_bits(key, m, scale, a, b, c)
+    if permute:
+        perm = jax.random.permutation(kperm, n).astype(jnp.int32)
+        rows = perm[rows]
+        cols = perm[cols]
+    return rows, cols
 
+
+def _rmat_bits(key: Array, m: int, scale: int,
+               a: float, b: float, c: float) -> tuple[Array, Array]:
+    """The shared per-level quadrant draw: m edges, scale bit levels.
+    Quadrants (0,0)/(0,1)/(1,0)/(1,1) with probability a/b/c/d."""
     def level(i, carry):
         rows, cols, key = carry
         key, sub = jax.random.split(key)
         u = jax.random.uniform(sub, (m,))
-        # quadrants: (0,0) w.p. a, (0,1) b, (1,0) c, (1,1) d
         rbit = u >= (a + b)
         cbit = ((u >= a) & (u < a + b)) | (u >= (a + b + c))
         rows = rows | (rbit.astype(jnp.int32) << i)
@@ -50,12 +60,7 @@ def rmat_edges(key: Array, scale: int, edgefactor: int = 16,
 
     rows = jnp.zeros((m,), jnp.int32)
     cols = jnp.zeros((m,), jnp.int32)
-    rows, cols, key = lax.fori_loop(0, scale, level, (rows, cols, key))
-
-    if permute:
-        perm = jax.random.permutation(kperm, n).astype(jnp.int32)
-        rows = perm[rows]
-        cols = perm[cols]
+    rows, cols, _ = lax.fori_loop(0, scale, level, (rows, cols, key))
     return rows, cols
 
 
@@ -63,3 +68,36 @@ def symmetrize(rows: Array, cols: Array) -> tuple[Array, Array]:
     """A + A^T edge set (the Graph500 symmetricization step,
     TopDownBFS.cpp: `Apply(..)` after generation)."""
     return (jnp.concatenate([rows, cols]), jnp.concatenate([cols, rows]))
+
+
+@partial(jax.jit, static_argnames=("scale", "edgefactor", "nchunks",
+                                   "permute"))
+def rmat_edges_chunk(key: Array, scale: int, edgefactor: int,
+                     chunk: Array, nchunks: int,
+                     a: float = 0.57, b: float = 0.19, c: float = 0.19,
+                     permute: bool = True) -> tuple[Array, Array]:
+    """Chunk ``chunk`` of ``nchunks`` of an R-MAT edge stream: the
+    memory-scalable generator (≅ DistEdgeList's per-rank generation,
+    DistEdgeList.cpp:223 — each rank/chunk draws its own slice of the
+    stream). The union over all chunks of one ``key`` is a well-defined
+    R-MAT sample of edgefactor*2^scale edges; chunk identity comes from
+    `fold_in`, so any chunk regenerates independently (the recompute-
+    not-communicate pattern: on a mesh, every device generates the same
+    chunk and keeps only its own tile's entries). ``chunk`` is traced —
+    one compile serves the whole stream."""
+    n = 1 << scale
+    m = edgefactor << scale
+    mc = -(-m // nchunks)
+    kperm, key = jax.random.split(key)
+    key = jax.random.fold_in(key, chunk)
+    rows, cols = _rmat_bits(key, mc, scale, a, b, c)
+    # the last chunk may overrun m: mark the overrun invalid (out of
+    # range) so tile builders drop it
+    pos = chunk * mc + jnp.arange(mc, dtype=jnp.int32)
+    rows = jnp.where(pos < m, rows, n)
+    cols = jnp.where(pos < m, cols, n)
+    if permute:
+        perm = jax.random.permutation(kperm, n).astype(jnp.int32)
+        rows = perm[jnp.clip(rows, 0, n - 1)] | (rows >> scale << scale)
+        cols = perm[jnp.clip(cols, 0, n - 1)] | (cols >> scale << scale)
+    return rows, cols

@@ -307,13 +307,30 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
     return out.reshape(-1)
 
 
+def _device_vmem_bytes() -> int:
+    """Per-core VMEM of the attached TPU (conservative default when
+    undiscoverable). v2/v3 have 16/32 MB; v4/v5 have 128."""
+    try:
+        d = jax.devices()[0]
+        kind = getattr(d, "device_kind", "") or ""
+    except Exception:
+        kind = ""
+    k = kind.lower()
+    if "v2" in k:
+        return 16 * 1024 * 1024
+    if "v3" in k:
+        return 32 * 1024 * 1024
+    return 128 * 1024 * 1024
+
+
 def _vmem_params():
     """Raise the scoped-VMEM ceiling: the resident-W kernels hold
-    several full word arrays (default limit is 16 MB; v5e has 128)."""
+    several full word arrays (default limit is 16 MB; the generation's
+    physical VMEM bounds it — 7/8 of it, leaving headroom)."""
     from jax.experimental.pallas import tpu as pltpu
     cls = getattr(pltpu, "CompilerParams", None) or \
         getattr(pltpu, "TPUCompilerParams")
-    return cls(vmem_limit_bytes=112 * 1024 * 1024)
+    return cls(vmem_limit_bytes=_device_vmem_bytes() * 7 // 8)
 
 
 def _sds(shape, dtype, like):
@@ -356,9 +373,11 @@ def apply_route_best(rp: RoutePlan, words: jax.Array) -> jax.Array:
     stage loop. Both are bit-identical."""
     from combblas_tpu.ops import pallas_kernels as pk
     # VMEM budget: W in+out+scratch + double-buffered mask stream =
-    # 5 x npad/8 bytes; v5e VMEM is 128 MB, so 2^27 slots is the
-    # largest resident network
-    if pk.enabled() and (1 << 13) <= rp.npad <= (1 << 27):
+    # 5 x npad/8 bytes, gated on the actual device generation's VMEM
+    # (2^27 slots on 128 MB v4/v5; v2/v3 cap lower instead of failing
+    # to compile — advisor round-3 finding)
+    npad_max = _device_vmem_bytes() // 5 * 8
+    if pk.enabled() and (1 << 13) <= rp.npad <= npad_max:
         return apply_route_pallas(rp, words)
     return apply_route(rp, words)
 

@@ -32,6 +32,14 @@ FLOP budget — the two-pass symbolic+numeric structure of the reference's
 hash SpGEMM (mtSpGEMM.h:467, estimateNNZ_Hash :812) becomes a cheap
 exact flop count (`spgemm_flops`) used as a shape oracle plus a fully
 vectorized expansion.
+
+Round-4 kernel notes (measured on a v5e chip): `lax.sort` with two i32
+keys + one payload runs ~3 ns/slot; a chunked segmented scan ~1 ns; a
+random gather ~9 ns; XLA scatter with monotone indices ~6 ns. The
+SpGEMM pipeline is therefore built from sorts and scans with exactly
+two gathers (the B-side expansion), and the A-side per-slot values are
+*scan-propagated* (scatter one value per run start, copy it forward
+with a segmented scan) instead of gathered.
 """
 
 from __future__ import annotations
@@ -118,6 +126,62 @@ def empty(nrows: int, ncols: int, cap: int, dtype=jnp.float32) -> Tile:
 # Construction (≅ SpTuples -> SpDCCols conversion: sort + dedup, SpTuples.h:88)
 # ---------------------------------------------------------------------------
 
+def _sortable(vals: Array) -> tuple[Array, Any]:
+    """Cast values to a dtype `lax.sort`/Pallas handle on TPU (no i1
+    vector registers in Mosaic — memory: bool payloads miscompile)."""
+    if vals.dtype == jnp.bool_:
+        return vals.astype(jnp.int32), jnp.bool_
+    return vals, None
+
+
+def _unsortable(vals: Array, restore) -> Array:
+    return vals.astype(restore) if restore is not None else vals
+
+
+def sort_compress(add: Monoid, srows: Array, scols: Array, vals: Array,
+                  nlive: Array, *, nrows: int, ncols: int, cap: int,
+                  dedup: bool = True):
+    """Shared COO→Tile compression: one 2-key sort (which compacts AND
+    pads, because invalid entries carry the (nrows, ncols) sentinel that
+    is also the padding convention), a segmented-scan dedup, and — only
+    when deduping — a second sort to re-compact the surviving group
+    tails. Inputs must already be sentinel-masked; ``nlive`` is the
+    number of non-sentinel entries. Returns (tile, live_group_count).
+
+    This replaces the round-3 lexsort + argsort-compaction + gather
+    chain (~8 passes over the expansion) with 2-3 passes.
+    """
+    vals, restore = _sortable(vals)
+    srows, scols, vals = lax.sort((srows, scols, vals), num_keys=2)
+    n = srows.shape[0]
+    pos = jnp.arange(n, dtype=jnp.int32)
+    live = pos < nlive
+    if dedup:
+        same = (srows[1:] == srows[:-1]) & (scols[1:] == scols[:-1])
+        starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
+        scanned = seg_scan_inclusive(add, vals, starts)
+        is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
+        nnz_full = jnp.sum(starts & live).astype(jnp.int32)
+        keep = is_last & live
+        srows = jnp.where(keep, srows, nrows)
+        scols = jnp.where(keep, scols, ncols)
+        srows, scols, vals = lax.sort((srows, scols, scanned), num_keys=2)
+    else:
+        nnz_full = nlive.astype(jnp.int32)
+    if cap >= n:
+        pad = cap - n
+        srows = jnp.concatenate([srows, jnp.full((pad,), nrows, jnp.int32)])
+        scols = jnp.concatenate([scols, jnp.full((pad,), ncols, jnp.int32)])
+        vals = jnp.concatenate([vals, jnp.zeros((pad,), vals.dtype)])
+    else:
+        srows, scols, vals = srows[:cap], scols[:cap], vals[:cap]
+    nnz = jnp.minimum(nnz_full, cap)
+    vals = jnp.where(jnp.arange(cap, dtype=jnp.int32) < nnz, vals,
+                     jnp.zeros((), vals.dtype))
+    t = Tile(srows, scols, _unsortable(vals, restore), nnz, nrows, ncols)
+    return t, nnz_full
+
+
 @partial(jax.jit, static_argnames=("add", "nrows", "ncols", "cap", "dedup",
                                    "return_full"))
 def from_coo(add: Monoid, rows: Array, cols: Array, vals: Array,
@@ -142,41 +206,10 @@ def from_coo(add: Monoid, rows: Array, cols: Array, vals: Array,
         valid = valid & (rows >= 0) & (rows < nrows) & (cols >= 0) & (cols < ncols)
     srows = jnp.where(valid, rows, nrows)
     scols = jnp.where(valid, cols, ncols)
-    order = jnp.lexsort((scols, srows))
-    srows, scols, vals = srows[order], scols[order], vals[order]
-    valid = valid[order]
-
-    if dedup:
-        same = (srows[1:] == srows[:-1]) & (scols[1:] == scols[:-1])
-        starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
-        gid = jnp.cumsum(starts) - 1
-        n = srows.shape[0]
-        reduced = add.segment_reduce(
-            jnp.where(valid, vals, add.identity(vals.dtype)),
-            jnp.where(valid, gid, n), n, sorted_ids=True)
-        vals = reduced[gid]
-        keep = starts & valid
-    else:
-        keep = valid
-
-    # compact live entries to the front (stable)
-    comp = jnp.argsort(~keep, stable=True)
-    srows, scols, vals, keep = srows[comp], scols[comp], vals[comp], keep[comp]
-    nnz_full = jnp.sum(keep).astype(jnp.int32)
-
-    if cap >= srows.shape[0]:
-        pad = cap - srows.shape[0]
-        srows = jnp.concatenate([srows, jnp.full((pad,), nrows, jnp.int32)])
-        scols = jnp.concatenate([scols, jnp.full((pad,), ncols, jnp.int32)])
-        vals = jnp.concatenate([vals, jnp.zeros((pad,), vals.dtype)])
-        keep = jnp.concatenate([keep, jnp.zeros((pad,), bool)])
-    else:
-        srows, scols, vals = srows[:cap], scols[:cap], vals[:cap]
-        keep = keep[:cap]
-    nnz = jnp.minimum(nnz_full, cap)
-    srows = jnp.where(keep, srows, nrows)
-    scols = jnp.where(keep, scols, ncols)
-    t = Tile(srows, scols, vals, nnz, nrows, ncols)
+    nlive = jnp.sum(valid).astype(jnp.int32)
+    t, nnz_full = sort_compress(add, srows, scols, vals, nlive,
+                                nrows=nrows, ncols=ncols, cap=cap,
+                                dedup=dedup)
     return (t, nnz_full) if return_full else t
 
 
@@ -215,8 +248,9 @@ def transpose(t: Tile) -> Tile:
     v = t.valid()
     rows = jnp.where(v, t.cols, t.ncols)
     cols = jnp.where(v, t.rows, t.nrows)
-    order = jnp.lexsort((cols, rows))
-    return Tile(rows[order], cols[order], t.vals[order], t.nnz,
+    vals, restore = _sortable(t.vals)
+    rows, cols, vals = lax.sort((rows, cols, vals), num_keys=2)
+    return Tile(rows, cols, _unsortable(vals, restore), t.nnz,
                 t.ncols, t.nrows)
 
 
@@ -362,6 +396,26 @@ def scan_inclusive(monoid: Monoid, data: Array, nchunks: int = 128) -> Array:
     return seg_scan_inclusive(monoid, data, starts, nchunks)
 
 
+#: copy-forward pseudo-monoid: combined with segment flags at run
+#: starts, the segmented scan propagates each run-start value across
+#: its run (combine keeps the accumulated = last-flagged value; the
+#: _seg_op wrapper resets at flags). Associative; identity unused.
+COPY_FWD = Monoid("copy_fwd", lambda a, b: a, 0)
+
+
+def seg_propagate(data_at_starts: Array, starts: Array,
+                  nchunks: int = 128) -> Array:
+    """out[i] = data_at_starts[j] for the latest j <= i with starts[j].
+
+    The scan-based replacement for an expansion gather `table[e[i]]`
+    when e is the run id: scatter each run's value at its start slot
+    (one small scatter), then copy it forward (~1 ns/slot vs ~9 ns/slot
+    for the gather). Slots before the first start hold garbage — mask
+    downstream. Works for any dtype/value (no monotonicity needed).
+    """
+    return seg_scan_inclusive(COPY_FWD, data_at_starts, starts, nchunks)
+
+
 def expand_indices(counts: Array, nslots: int):
     """Run-length-decode: entry e with counts[e]>0 owns slots
     [offs[e], offs[e]+counts[e]); returns (e_of_slot, offs, total)
@@ -409,9 +463,8 @@ def col_structure(t: Tile):
     v = t.valid()
     sc = jnp.where(v, t.cols, t.ncols)
     srw = jnp.where(v, t.rows, t.nrows)
-    order = jnp.lexsort((srw, sc)).astype(jnp.int32)
-    crows = srw[order]
-    ccols = sc[order]
+    ccols, crows, order = lax.sort(
+        (sc, srw, jnp.arange(t.cap, dtype=jnp.int32)), num_keys=2)
     cstarts = jnp.searchsorted(
         ccols, jnp.arange(t.ncols + 1, dtype=jnp.int32),
         side="left").astype(jnp.int32)
@@ -473,7 +526,11 @@ def spmv_masked_hits(sr: Semiring, t: Tile, x: Array,
 
 
 # ---------------------------------------------------------------------------
-# SpGEMM (≅ mtSpGEMM.h LocalSpGEMMHash :467) — ESC with static FLOP budget
+# SpGEMM (≅ mtSpGEMM.h LocalSpGEMMHash :467) — ESC2: sort/scan pipeline
+# with a static FLOP budget. The symbolic/numeric two-pass of the
+# reference's hash kernel maps to: exact flop count (shape oracle) +
+# scan-propagated expansion + sort-compress. Only two gathers total
+# (B-side cols/vals); A-side values ride segmented copy-forward scans.
 # ---------------------------------------------------------------------------
 
 @jax.jit
@@ -495,10 +552,64 @@ def spgemm_flops(a: Tile, b: Tile) -> int:
     return int(np.asarray(spgemm_flops_per_entry(a, b), dtype=np.int64).sum())
 
 
+def _flops_cap_guard(flops_cap: int):
+    if flops_cap > 2**30 - 1:
+        raise ValueError(
+            f"flops_cap {flops_cap} > 2^30-1: expansion indices saturate — "
+            "bound the per-call flop budget by splitting the multiply into "
+            "phases (parallel.spgemm.spgemm_phased)")
+
+
+def _esc2_expand(sr: Semiring, a: Tile, per: Array, base: Array, b: Tile,
+                 flops_cap: int):
+    """Materialize the product expansion without per-slot A-side gathers.
+
+    ``per[e]``/``base[e]``: product count and B-array start index for A
+    entry e. Each A entry owns a contiguous run of slots; its row,
+    value, and B offset are scattered once at the run start and
+    copy-forward-scanned across the run, so the only expansion-sized
+    gathers are B's cols/vals at ``bidx = (base-offs) + slot``.
+    Returns (crow, ccol, cval, total); slots >= total carry garbage.
+    """
+    incl = scan_inclusive(SATADD, per)
+    offs = incl - per                      # exclusive prefix
+    total = incl[-1]
+    live_e = (per > 0) & (offs < flops_cap)
+    tgt = jnp.where(live_e, offs, flops_cap)
+
+    def scat(x):
+        return jnp.zeros((flops_cap + 1,), x.dtype).at[tgt].set(
+            x, mode="drop")[:flops_cap]
+
+    starts = scat(jnp.ones(per.shape, jnp.int32)) > 0
+    crow = seg_propagate(scat(a.rows), starts)
+    delta = seg_propagate(scat(base - offs), starts)
+    avals, restore = _sortable(a.vals)
+    aval = _unsortable(seg_propagate(scat(avals), starts), restore)
+    slots = jnp.arange(flops_cap, dtype=jnp.int32)
+    bidx = jnp.clip(delta + slots, 0, b.cap - 1)
+    ccol = b.cols[bidx]
+    cval = sr.multiply(aval, b.vals[bidx])
+    return crow, ccol, cval, total
+
+
+def _esc2_finish(sr: Semiring, a: Tile, b: Tile, per: Array, base: Array,
+                 flops_cap: int, out_cap: int, dedup: bool) -> Tile:
+    crow, ccol, cval, total = _esc2_expand(sr, a, per, base, b, flops_cap)
+    live = jnp.arange(flops_cap, dtype=jnp.int32) < total
+    crow = jnp.where(live, crow, a.nrows)
+    ccol = jnp.where(live, ccol, b.ncols)
+    t, _ = sort_compress(sr.add, crow, ccol, cval,
+                         jnp.minimum(total, flops_cap),
+                         nrows=a.nrows, ncols=b.ncols, cap=out_cap,
+                         dedup=dedup)
+    return t
+
+
 def spgemm_ranged(sr: Semiring, a: Tile, b: Tile, *, a_lo: int, b_lo: int,
                   length: int, flops_cap: int, out_cap: int,
                   dedup: bool = True) -> Tile:
-    """c = A[:, a_lo:a_lo+length] ⊗ B[b_lo:b_lo+length, :] — the ESC
+    """c = A[:, a_lo:a_lo+length] ⊗ B[b_lo:b_lo+length, :] — the ESC2
     multiply restricted to an inner-dimension window, without
     compacting either operand (entries outside the window are masked).
 
@@ -508,12 +619,7 @@ def spgemm_ranged(sr: Semiring, a: Tile, b: Tile, *, a_lo: int, b_lo: int,
     Padding entries (row == nrows) sort past every window, so the
     searchsorted row pointers need no validity fixup.
     """
-    _SAT = 2**30 - 1
-    if flops_cap > _SAT:
-        raise ValueError(
-            f"flops_cap {flops_cap} > 2^30-1: expansion indices saturate — "
-            "bound the per-call flop budget by splitting the multiply into "
-            "phases (parallel.spgemm.spgemm_phased)")
+    _flops_cap_guard(flops_cap)
     targets = jnp.arange(length + 1, dtype=jnp.int32) + jnp.asarray(
         b_lo, jnp.int32)
     bptr = jnp.searchsorted(b.rows, targets, side="left").astype(jnp.int32)
@@ -521,46 +627,53 @@ def spgemm_ranged(sr: Semiring, a: Tile, b: Tile, *, a_lo: int, b_lo: int,
     in_range = a.valid() & (p >= 0) & (p < length)
     pcl = jnp.clip(p, 0, length - 1)
     per = jnp.where(in_range, bptr[pcl + 1] - bptr[pcl], 0)
-    e_of_slot, offs, total = expand_indices(per, flops_cap)
-    slots = jnp.arange(flops_cap, dtype=jnp.int32)
-    e = jnp.clip(e_of_slot, 0, a.cap - 1)
-    live = slots < total
-    t = slots - offs[e]
-    bidx = jnp.clip(bptr[jnp.clip(p[e], 0, length - 1)] + t, 0, b.cap - 1)
-    crow = a.rows[e]
-    ccol = b.cols[bidx]
-    cval = sr.multiply(a.vals[e], b.vals[bidx])
-    return from_coo(sr.add, crow, ccol, cval, nrows=a.nrows, ncols=b.ncols,
-                    cap=out_cap, valid=live, dedup=dedup)
+    base = bptr[pcl]
+    return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup)
 
 
 @partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup"))
 def spgemm(sr: Semiring, a: Tile, b: Tile, *, flops_cap: int, out_cap: int,
            dedup: bool = True) -> Tile:
-    """c = a ⊗ b over ``sr`` (expand-sort-compress, fully vectorized).
+    """c = a ⊗ b over ``sr`` (expand-scan-sort-compress, vectorized).
 
     ``flops_cap`` bounds the expansion (#scalar multiplies); products
     beyond it are dropped — size it with `spgemm_flops`. ``out_cap`` is
     the capacity of the result tile.
     """
     assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
-    _SAT = 2**30 - 1
-    if flops_cap > _SAT:
-        raise ValueError(
-            f"flops_cap {flops_cap} > 2^30-1: expansion indices saturate — "
-            "bound the per-call flop budget by splitting the multiply into "
-            "phases (parallel.spgemm.spgemm_phased)")
+    _flops_cap_guard(flops_cap)
     bptr = row_starts(b)
     acol = jnp.clip(a.cols, 0, a.ncols - 1)
     per = jnp.where(a.valid(), bptr[acol + 1] - bptr[acol], 0)
-    e_of_slot, offs, total = expand_indices(per, flops_cap)
-    slots = jnp.arange(flops_cap, dtype=jnp.int32)
-    e = jnp.clip(e_of_slot, 0, a.cap - 1)
-    live = slots < total
-    t = slots - offs[e]
-    bidx = jnp.clip(bptr[jnp.clip(a.cols[e], 0, a.ncols - 1)] + t, 0, b.cap - 1)
-    crow = a.rows[e]
-    ccol = b.cols[bidx]
-    cval = sr.multiply(a.vals[e], b.vals[bidx])
-    return from_coo(sr.add, crow, ccol, cval, nrows=a.nrows, ncols=b.ncols,
-                    cap=out_cap, valid=live, dedup=dedup)
+    base = bptr[acol]
+    return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup)
+
+
+@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup"))
+def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
+                     *, flops_cap: int, out_cap: int,
+                     dedup: bool = True) -> Tile:
+    """c = a ⊗ B[:, clo:chi) with *dynamic* (traced) column bounds —
+    the local body of single-tile phased SpGEMM (≅ MemEfficientSpGEMM's
+    ColSplit windows, ParFriends.h:555), without materializing the B
+    window: within each B row the window's entries are contiguous (the
+    tile is (row, col)-sorted), so per-row window counts and start
+    offsets come from two segmented reductions over B. Because clo/chi
+    are traced, every phase with the same cap buckets reuses ONE
+    compiled kernel. Output columns keep their global indices.
+    """
+    assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
+    _flops_cap_guard(flops_cap)
+    from combblas_tpu.ops.semiring import PLUS
+    v = b.valid()
+    inwin = (v & (b.cols >= clo) & (b.cols < chi)).astype(jnp.int32)
+    before = (v & (b.cols < clo)).astype(jnp.int32)
+    starts_b, seg_ends, nonempty = row_structure(b)
+    cnt_w = seg_reduce_sorted(PLUS, inwin, starts_b, seg_ends, nonempty)
+    n_before = seg_reduce_sorted(PLUS, before, starts_b, seg_ends, nonempty)
+    bptr = row_starts(b)
+    bstart_w = bptr[:-1] + n_before
+    acol = jnp.clip(a.cols, 0, a.ncols - 1)
+    per = jnp.where(a.valid(), cnt_w[acol], 0)
+    base = bstart_w[acol]
+    return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup)

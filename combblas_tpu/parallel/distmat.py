@@ -170,6 +170,161 @@ def from_global_coo(add: Monoid, grid: ProcGrid, rows, cols, vals,
         _ceil_div(nrows, grid.pr), _ceil_div(ncols, grid.pc))
 
 
+@partial(jax.jit, static_argnames=("add", "grid", "nrows", "ncols",
+                                   "tile_m", "tile_n", "cap_out", "dedup"))
+def _merge_chunk(add: Monoid, grid: ProcGrid, acc_r, acc_c, acc_v, acc_n,
+                 rows, cols, vals, nrows: int, ncols: int,
+                 tile_m: int, tile_n: int, cap_out: int, dedup: bool):
+    """Fold one global-coordinate COO chunk into the per-tile
+    accumulators: per tile, concat (acc live prefix sentinels intact) +
+    the chunk's owned entries, one sort_compress. Returns the new
+    stacked tiles plus per-tile true (pre-clamp) counts for growth."""
+    pr, pc = grid.pr, grid.pc
+    ti = jnp.repeat(jnp.arange(pr, dtype=jnp.int32), pc)
+    tj = jnp.tile(jnp.arange(pc, dtype=jnp.int32), pr)
+
+    def one(i, j, ar, ac, av, an):
+        # explicit LOGICAL bounds, not just tile-index match: on grids
+        # whose dims don't divide nrows/ncols, an out-of-range marker
+        # (e.g. the generator's overrun sentinel n) can land inside the
+        # last block's PADDING and would survive as a phantom entry
+        inb = (rows >= 0) & (rows < nrows) & (cols >= 0) & (cols < ncols)
+        mine = inb & (rows // tile_m == i) & (cols // tile_n == j)
+        lr = jnp.where(mine, rows - i * tile_m, tile_m)
+        lc = jnp.where(mine, cols - j * tile_n, tile_n)
+        crr = jnp.concatenate([ar, lr])
+        ccc = jnp.concatenate([ac, lc])
+        cvv = jnp.concatenate([av, vals.astype(av.dtype)])
+        nlive = an + jnp.sum(mine).astype(jnp.int32)
+        t, full = tl.sort_compress(add, crr, ccc, cvv, nlive,
+                                   nrows=tile_m, ncols=tile_n,
+                                   cap=cap_out, dedup=dedup)
+        return t.rows, t.cols, t.vals, t.nnz, full
+
+    r, c, v, n, full = jax.vmap(one)(ti, tj, acc_r.reshape(-1, acc_r.shape[-1]),
+                                     acc_c.reshape(-1, acc_c.shape[-1]),
+                                     acc_v.reshape(-1, acc_v.shape[-1]),
+                                     acc_n.reshape(-1))
+    # keep the accumulators mesh-sharded THROUGH the chunk loop: the
+    # chunk is replicated (recompute-not-communicate), but each tile's
+    # sort must run on its owner — an unsharded vmap would fold the
+    # whole matrix on one device and OOM exactly at the scales this
+    # builder exists for
+    shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
+    shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
+    from jax import lax as _lax
+    return (_lax.with_sharding_constraint(r.reshape(pr, pc, cap_out), shard3),
+            _lax.with_sharding_constraint(c.reshape(pr, pc, cap_out), shard3),
+            _lax.with_sharding_constraint(v.reshape(pr, pc, cap_out), shard3),
+            _lax.with_sharding_constraint(n.reshape(pr, pc), shard2),
+            full.reshape(pr, pc))
+
+
+def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
+                    nrows: int, ncols: int, *, val_dtype=jnp.bool_,
+                    cap: Optional[int] = None, dedup: bool = True,
+                    est_total: Optional[int] = None) -> DistSpMat:
+    """Build a DistSpMat from a chunked COO stream without ever
+    materializing the global edge list (≅ the DistEdgeList model:
+    per-rank generation + SparseCommon shuffle, DistEdgeList.cpp:223 +
+    SpParMat.cpp:2835 — here, chunks bound peak memory and owners
+    filter instead of communicating).
+
+    ``chunk_fn(k)`` returns (rows, cols, vals) in GLOBAL coordinates;
+    out-of-range coordinates are dropped (the generator marks overrun
+    that way). All chunks must share one static shape, so the per-chunk
+    fold compiles once per capacity bucket; the capacity grows
+    geometrically on overflow (one scalar readback per chunk) and only
+    the offending chunk re-merges.
+    """
+    pr, pc = grid.pr, grid.pc
+    tile_m = _ceil_div(nrows, pr)
+    tile_n = _ceil_div(ncols, pc)
+    if cap is None:
+        est = est_total if est_total is not None else 0
+        cap = max(1024, _ceil_div(est, pr * pc))
+    cap = -(-cap // 128) * 128
+
+    acc = None
+    for k in range(nchunks):
+        rows, cols, vals = chunk_fn(k)
+        rows = jnp.asarray(rows, jnp.int32)
+        cols = jnp.asarray(cols, jnp.int32)
+        vals = jnp.asarray(vals, val_dtype)
+        if acc is None:
+            shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
+            shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
+            acc = (jax.device_put(
+                       jnp.full((pr, pc, cap), tile_m, jnp.int32), shard3),
+                   jax.device_put(
+                       jnp.full((pr, pc, cap), tile_n, jnp.int32), shard3),
+                   jax.device_put(
+                       jnp.zeros((pr, pc, cap), val_dtype), shard3),
+                   jax.device_put(jnp.zeros((pr, pc), jnp.int32), shard2))
+        prev = acc
+        out = _merge_chunk(add, grid, *acc, rows, cols, vals,
+                           nrows, ncols, tile_m, tile_n, cap, dedup)
+        max_full = int(np.asarray(out[4]).max())
+        if max_full > cap:
+            # grow with headroom for the remaining stream and re-merge
+            # THIS chunk only (prev acc is untouched)
+            frac = (k + 1) / nchunks
+            cap = -(-int(max_full / frac * 1.1) // 128) * 128
+            prev = tuple(
+                _grow_stack(x, cap, fill)
+                for x, fill in zip(prev[:3], (tile_m, tile_n, None))
+            ) + (prev[3],)
+            out = _merge_chunk(add, grid, *prev, rows, cols, vals,
+                               nrows, ncols, tile_m, tile_n, cap, dedup)
+            assert int(np.asarray(out[4]).max()) <= cap
+        acc = out[:4]
+
+    shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
+    shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
+    return DistSpMat(
+        jax.device_put(acc[0], shard3), jax.device_put(acc[1], shard3),
+        jax.device_put(acc[2], shard3), jax.device_put(acc[3], shard2),
+        grid, nrows, ncols, tile_m, tile_n)
+
+
+def _grow_stack(x, new_cap, fill):
+    pr, pc, cap = x.shape
+    extra = new_cap - cap
+    if extra <= 0:
+        return x[:, :, :new_cap]
+    pad = (jnp.full((pr, pc, extra), fill, x.dtype) if fill is not None
+           else jnp.zeros((pr, pc, extra), x.dtype))
+    return jnp.concatenate([x, pad], axis=-1)
+
+
+def from_rmat(add: Monoid, grid: ProcGrid, key, scale: int,
+              edgefactor: int = 16, *, symmetrize: bool = True,
+              chunk_edges: int = 1 << 24, val_dtype=jnp.bool_,
+              permute: bool = True, cap: Optional[int] = None,
+              dedup: bool = True) -> DistSpMat:
+    """Memory-scalable Graph500 matrix build: R-MAT generated and
+    folded in chunks (≅ DistEdgeList::GenGraph500Data +
+    SpParMat(DistEdgeList) without the global edge array — the peak
+    intermediate is one chunk, not the 2*ef*2^scale edge list)."""
+    from combblas_tpu.ops import generate
+    n = 1 << scale
+    m = edgefactor << scale
+    nchunks = max(1, _ceil_div(m, chunk_edges))
+
+    def chunk_fn(k):
+        r, c = generate.rmat_edges_chunk(key, scale, edgefactor,
+                                         jnp.int32(k), nchunks,
+                                         permute=permute)
+        if symmetrize:
+            r, c = generate.symmetrize(r, c)
+        return r, c, jnp.ones_like(r, val_dtype)
+
+    sym_m = 2 * m if symmetrize else m
+    return from_coo_chunks(add, grid, chunk_fn, nchunks, n, n,
+                           val_dtype=val_dtype, cap=cap, dedup=dedup,
+                           est_total=int(sym_m * 0.75))
+
+
 def from_dense(add: Monoid, grid: ProcGrid, dense, zero,
                cap: Optional[int] = None) -> DistSpMat:
     """Test/golden-model constructor from a global dense array."""

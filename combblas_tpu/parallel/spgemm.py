@@ -193,7 +193,7 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         my_c = lax.axis_index(COL_AXIS)
         ar, ac, av, an = ar[0, 0], ac[0, 0], av[0, 0], an[0, 0]
         br, bc, bv, bn = br[0, 0], bc[0, 0], bv[0, 0], bn[0, 0]
-        acc = tl.empty(tile_m, tile_nb, out_cap, out_dtype)
+        acc = None
         at = bt = None
         prev_ja = prev_ib = None
         for (lo, hi, ja, la, ib, lb) in intervals:
@@ -207,10 +207,17 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                 bt = _bcast_tile(br, bc, bv, bn, my_r == ib, ROW_AXIS,
                                  b.tile_m, b.tile_n)
                 prev_ib = ib
-            part = tl.spgemm_ranged(sr, at, bt, a_lo=la, b_lo=lb,
-                                    length=hi - lo, flops_cap=flops_cap,
-                                    out_cap=stage_cap)
-            acc = tl.concat_merge(sr.add, [acc, part], cap=out_cap)
+            part = tl.spgemm_ranged(
+                sr, at, bt, a_lo=la, b_lo=lb, length=hi - lo,
+                flops_cap=flops_cap,
+                out_cap=out_cap if acc is None else stage_cap)
+            part = part.astype(out_dtype)
+            if acc is None:
+                # first stage IS the accumulator (already sorted/deduped)
+                # — a 1-stage product (e.g. any 1x1 grid) does no merge
+                acc = part
+            else:
+                acc = tl.concat_merge(sr.add, [acc, part], cap=out_cap)
         return (acc.rows[None, None], acc.cols[None, None],
                 acc.vals[None, None], acc.nnz[None, None])
 
@@ -287,6 +294,139 @@ def _col_window(b: DistSpMat, lo: int, w: int) -> DistSpMat:
                      b.tile_m, hi - lo)
 
 
+def _bucket_fine(x: int, floor: int = 4096) -> int:
+    """Quarter-octave capacity bucket (2^k * {1, 1.25, 1.5, 1.75}):
+    at most 25% padded slots — the expansion cost is proportional to
+    the bucketed size, so power-of-two buckets would waste up to 2x —
+    while keeping the compile-shape count at 4 per octave."""
+    x = max(x, floor, 1)
+    k = (x - 1).bit_length() - 1
+    base = 1 << k
+    step = base // 4
+    return base + step * (-(-(x - base) // step)) if x > base else base
+
+
+def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
+                    phases: Optional[int] = None,
+                    phase_flop_budget: int = 2 ** 26,
+                    cap_round: int = 4096) -> list[tuple[int, int, int, int]]:
+    """Single-tile phase plan: ONE host fetch of each operand's
+    structure, exact per-B-column flop counts, balanced-flop window
+    boundaries. Returns [(clo, chi, flops_cap, out_cap)] with caps
+    bucketed so every phase shares one compiled kernel."""
+    at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
+                 a.tile_m, a.tile_n)
+    bt = tl.Tile(b.rows[0, 0], b.cols[0, 0], b.vals[0, 0], b.nnz[0, 0],
+                 b.tile_m, b.tile_n)
+    same = a.rows is b.rows
+    ac = np.asarray(at.cols)
+    annz = int(np.asarray(at.nnz))
+    acolcnt = np.bincount(ac[:annz], minlength=a.tile_n + 1)[:a.tile_n]
+    if same:
+        br, bc, bnnz = np.asarray(at.rows), ac, annz
+    else:
+        br, bc = np.asarray(bt.rows), np.asarray(bt.cols)
+        bnnz = int(np.asarray(bt.nnz))
+    fe = acolcnt[np.clip(br[:bnnz], 0, a.tile_n - 1)].astype(np.int64)
+    fcol = np.zeros(b.tile_n + 1, np.int64)
+    np.add.at(fcol, bc[:bnnz], fe)
+    cum = np.cumsum(fcol[:b.tile_n])
+    total = int(cum[-1]) if b.tile_n else 0
+    if phases is None:
+        phases = max(1, -(-total // phase_flop_budget))
+    phases = min(phases, b.tile_n)
+    # balanced-flop window boundaries (not equal width): every phase
+    # lands in the same cap bucket, so one compile covers the run
+    bounds = sorted({int(np.searchsorted(cum, total * k / phases))
+                     for k in range(1, phases)} | {0, b.tile_n})
+    windows = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if hi <= lo:
+            continue
+        f = int(cum[hi - 1] - (cum[lo - 1] if lo else 0))
+        if f > _SAT:
+            raise ValueError(
+                f"column window [{lo},{hi}) needs {f} products > 2^30-1; "
+                "a single output column exceeds the expansion ceiling — "
+                "shard the matrix over a mesh instead")
+        oc = min(max(f, 1), a.tile_m * (hi - lo))
+        # clamp the bucket, not the flop count: f <= _SAT always fits,
+        # only the rounded-up bucket can cross the guard
+        windows.append((lo, hi, min(_bucket_fine(max(f, 1), cap_round), _SAT),
+                        min(_bucket_fine(oc, cap_round), _SAT)))
+    return windows
+
+
+def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
+                phases: Optional[int], phase_flop_budget: int,
+                prune_hook, out_cap: Optional[int],
+                cap_round: int) -> DistSpMat:
+    """Single-tile phased SpGEMM: plan once on host (ONE fetch of each
+    operand's structure), then run every phase through one compiled
+    dynamic-window kernel (`tile.spgemm_colwindow`). No per-phase host
+    planning, no B-window materialization, no device_put round-trips —
+    the round-3 path spent ~10x the kernel time on those.
+    """
+    grid = a.grid
+    at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
+                 a.tile_m, a.tile_n)
+    bt = tl.Tile(b.rows[0, 0], b.cols[0, 0], b.vals[0, 0], b.nnz[0, 0],
+                 b.tile_m, b.tile_n)
+    windows = plan_colwindows(a, b, phases=phases,
+                              phase_flop_budget=phase_flop_budget,
+                              cap_round=cap_round)
+
+    def wrap(t: tl.Tile) -> DistSpMat:
+        return DistSpMat(t.rows[None, None], t.cols[None, None],
+                         t.vals[None, None], t.nnz[None, None],
+                         grid, a.nrows, b.ncols, t.nrows, t.ncols)
+
+    parts: list[tl.Tile] = []
+
+    def fold(parts: list[tl.Tile], cap: Optional[int]) -> tl.Tile:
+        rows = jnp.concatenate([t.rows for t in parts])
+        cols = jnp.concatenate([t.cols for t in parts])
+        vals = jnp.concatenate([t.vals for t in parts])
+        nlive = sum(t.nnz for t in parts)
+        if cap is None:
+            cap = _bucket_fine(int(np.asarray(nlive)), cap_round)
+        # phases cover disjoint output columns: concat + one sort, no
+        # dedup pass (sort_compress's no-dedup path is a single sort)
+        t, _ = tl.sort_compress(sr.add, rows, cols, vals, nlive,
+                                nrows=a.tile_m, ncols=b.tile_n, cap=cap,
+                                dedup=False)
+        return t
+
+    for (lo, hi, fc, oc) in windows:
+        cp = tl.spgemm_colwindow(
+            sr, at, bt, jnp.asarray(lo, jnp.int32), jnp.asarray(hi, jnp.int32),
+            flops_cap=fc, out_cap=oc)
+        if prune_hook is not None:
+            cp = _unwrap_1x1(prune_hook(wrap(cp)))
+        # shrink to the true output size: out_cap above is flops-bounded
+        # (~2-4x the deduped nnz on power-law graphs), and holding
+        # several flops-sized parts OOMs the 16 GB HBM at scale >= 16.
+        # One scalar readback per phase buys a bounded working set.
+        cp = cp.with_capacity(_bucket_fine(int(np.asarray(cp.nnz)), 128))
+        parts.append(cp)
+        if len(parts) >= 8:
+            parts = [fold(parts, None)]
+    out = parts[0] if len(parts) == 1 else fold(parts, None)
+    if out_cap is not None and out.cap != out_cap:
+        need = int(np.asarray(out.nnz))
+        if out_cap < need:
+            raise ValueError(
+                f"out_cap {out_cap} < {need} surviving entries; "
+                "concatenation would silently drop")
+        out = out.with_capacity(out_cap)
+    return wrap(out)
+
+
+def _unwrap_1x1(m: DistSpMat) -> tl.Tile:
+    return tl.Tile(m.rows[0, 0], m.cols[0, 0], m.vals[0, 0], m.nnz[0, 0],
+                   m.tile_m, m.tile_n)
+
+
 def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                   phases: Optional[int] = None,
                   phase_flop_budget: int = 2 ** 28,
@@ -299,12 +439,24 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
 
     ``phases=None`` auto-selects ceil(total_flops / phase_flop_budget)
     (≅ CalculateNumberOfPhases, ParFriends.h:733). ``prune_hook``
-    receives each phase's C slice (a DistSpMat whose columns are true C
-    columns) and returns the pruned slice — the MCLPruneRecoverySelect
-    attachment point. This is the route past the 2^30 single-multiply
-    expansion ceiling: per-phase expansions stay small regardless of
-    total FLOPs.
+    receives each phase's C slice and returns the pruned slice — the
+    MCLPruneRecoverySelect attachment point. The hook must use ONLY
+    per-column semantics (reduce/select/prune within each column),
+    never column identity: on meshes the slice carries window-local
+    column ids (width = the window), while the 1x1 fast path passes a
+    full-width matrix with global column ids and the off-window
+    columns empty — both are "each column is a true C column", but a
+    hook that indexes columns by absolute position would see different
+    ids. This is the route past the 2^30 single-multiply expansion
+    ceiling: per-phase expansions stay small regardless of total FLOPs.
     """
+    if a.grid.pr == 1 and a.grid.pc == 1:
+        _check_product(a, b)
+        return _phased_1x1(sr, a, b, phases=phases,
+                           phase_flop_budget=phase_flop_budget,
+                           prune_hook=prune_hook, out_cap=out_cap,
+                           cap_round=cap_round)
+
     def mult(bp, p, phases):
         return _planned_summa(sr, a, bp, cap_round,
                               f"phase {p}/{phases} of phased SpGEMM")

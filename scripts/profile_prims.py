@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Microbench candidate primitives for the ESC2 SpGEMM kernel."""
+import time
+import jax, jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops.semiring import MAX, PLUS
+
+def timeit(label, fn, reps=3):
+    out = fn(); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label}: {dt*1000:.1f} ms", flush=True)
+
+N = 1 << 24
+key = jax.random.randint(jax.random.key(0), (N,), 0, 1 << 30, jnp.int32)
+val = jax.random.uniform(jax.random.key(1), (N,))
+k2 = jax.random.randint(jax.random.key(2), (N,), 0, 1 << 14, jnp.int32)
+
+# sorts
+f2k = jax.jit(lambda a, b, v: lax.sort((a, b, v), num_keys=2))
+timeit("sort 2key+f32payload 16.7M", lambda: f2k(k2, key, val))
+N2 = 1 << 26
+keyb = jnp.tile(key, 4); valb = jnp.tile(val, 4); k2b = jnp.tile(k2, 4)
+timeit("sort 2key+f32payload 67M", lambda: f2k(k2b, keyb, valb))
+
+# scans
+timeit("jnp.cumsum 16.7M i32", lambda: jax.jit(jnp.cumsum)(k2))
+timeit("chunked scan_inclusive MAX 16.7M", lambda: jax.jit(lambda x: tl.scan_inclusive(MAX, x))(k2))
+timeit("chunked scan_inclusive MAX 67M", lambda: jax.jit(lambda x: tl.scan_inclusive(MAX, x))(k2b))
+timeit("assoc_scan max 16.7M flat", lambda: jax.jit(lambda x: lax.associative_scan(jnp.maximum, x))(k2))
+
+# monotone scatter: compact 16.7M inputs to ~N/4 live slots
+live = (key & 3) == 0
+pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+cap = N // 3
+tgt = jnp.where(live, pos, cap)
+f_scat = jax.jit(lambda tgt, val: jnp.zeros((cap,), val.dtype).at[tgt].set(val, mode="drop"))
+timeit("monotone scatter-set 16.7M->5.6M", lambda: f_scat(tgt, val))
+f_scat_add = jax.jit(lambda tgt, val: jnp.zeros((cap,), val.dtype).at[tgt].add(val, mode="drop"))
+timeit("monotone scatter-add 16.7M->5.6M", lambda: f_scat_add(tgt, val))
+
+# gathers: i32 vs pair-gather from (cap,2)
+tab = jax.random.randint(jax.random.key(3), (1 << 18,), 0, 100, jnp.int32)
+idx = jax.random.randint(jax.random.key(4), (N,), 0, 1 << 18, jnp.int32)
+timeit("gather i32 16.7M from 262k", lambda: jax.jit(lambda t, i: t[i])(tab, idx))
+tab2 = jnp.stack([tab, tab], 1)
+timeit("gather (i,2) pair 16.7M from 262k", lambda: jax.jit(lambda t, i: t[i])(tab2, idx))
+
+# dense matmul + extraction probe (scale-14 tile)
+M = 1 << 14
+ad = jax.random.uniform(jax.random.key(5), (M, M), jnp.float32)
+ad = jnp.where(ad < 0.001, ad, 0.0)
+f_mm = jax.jit(lambda a, b: a @ b)
+timeit("dense matmul 16k^3 f32", lambda: f_mm(ad, ad), reps=2)
+adb = ad.astype(jnp.bfloat16)
+timeit("dense matmul 16k^3 bf16", lambda: f_mm(adb, adb), reps=2)
+# row-wise rank via transposed-major cumsum
+f_rank = jax.jit(lambda c: lax.associative_scan(jnp.add, (c != 0).astype(jnp.int32), axis=0))
+cd = f_mm(ad, ad)
+timeit("per-col cumsum 268M (axis0)", lambda: f_rank(cd), reps=2)

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Profile the current phased SpGEMM at scale-14 A*A on the real chip:
+per-phase host-plan time vs device time, phase count, flop totals."""
+import time, sys
+import jax, jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel.grid import ProcGrid
+
+scale = int(sys.argv[1]) if len(sys.argv) > 1 else 14
+budget = int(sys.argv[2]) if len(sys.argv) > 2 else 2 ** 24
+
+grid = ProcGrid.make()
+n = 1 << scale
+t0 = time.perf_counter()
+r, c = generate.rmat_edges(jax.random.key(1), scale, 16)
+a = dm.from_global_coo(S.PLUS, grid, r, c, jnp.ones_like(r, jnp.float32), n, n)
+jax.block_until_ready(a.rows)
+print(f"build: {time.perf_counter()-t0:.2f}s nnz={a.getnnz()}", flush=True)
+
+t0 = time.perf_counter()
+total = spg.plan_flops_total(a, a)
+print(f"plan_flops_total: {total} ({time.perf_counter()-t0:.2f}s host)", flush=True)
+print(f"phases at budget {budget}: {max(1, -(-total // budget))}", flush=True)
+
+# time one plan_spgemm call (the per-phase host pass)
+t0 = time.perf_counter()
+fc, oc = spg.plan_spgemm(a, a)
+print(f"plan_spgemm(full): fc={fc} oc={oc} ({time.perf_counter()-t0:.2f}s host)", flush=True)
+
+# one _col_window call
+t0 = time.perf_counter()
+bp = spg._col_window(a, 0, max(1, a.tile_n // max(1, -(-total // budget))))
+jax.block_until_ready(bp.rows)
+print(f"_col_window: {time.perf_counter()-t0:.2f}s  wcap={bp.cap}", flush=True)
+
+# full phased multiply, timed end to end (second call = warm)
+for it in range(2):
+    t0 = time.perf_counter()
+    cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a, phase_flop_budget=budget)
+    cm.vals.block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"iter{it}: {dt:.2f}s c_nnz={cm.getnnz()}", flush=True)

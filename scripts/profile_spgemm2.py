@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Time warm components of the phased SpGEMM pipeline individually."""
+import time, sys
+import jax, jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu.ops import generate, tile as tl
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel.grid import ProcGrid
+
+scale = int(sys.argv[1]) if len(sys.argv) > 1 else 14
+
+grid = ProcGrid.make()
+n = 1 << scale
+r, c = generate.rmat_edges(jax.random.key(1), scale, 16)
+a = dm.from_global_coo(S.PLUS, grid, r, c, jnp.ones_like(r, jnp.float32), n, n)
+jax.block_until_ready(a.rows)
+print(f"nnz={a.getnnz()} cap={a.cap}", flush=True)
+
+def timeit(label, fn, reps=3):
+    fn()  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn()
+    jax.block_until_ready(out)
+    # honest readback of a dependent scalar
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{label}: {dt*1000:.1f} ms", flush=True)
+    return out
+
+w = a.tile_n // 4
+# col window (device part only)
+timeit("col_window", lambda: spg._col_window(a, 0, w).rows)
+
+bp = spg._col_window(a, 0, w)
+fc, oc = spg.plan_spgemm(a, bp)
+fcb = spg._bucket_cap(fc, 4096); ocb = spg._bucket_cap(oc, 4096)
+print(f"window plan: fc={fc}->{fcb} oc={oc}->{ocb}", flush=True)
+
+t0 = time.perf_counter(); fc2, oc2 = spg.plan_spgemm(a, bp)
+print(f"plan_spgemm(window) host: {(time.perf_counter()-t0)*1000:.1f} ms", flush=True)
+
+timeit("summa(window) warm", lambda: spg.summa(S.PLUS_TIMES_F32, a, bp, flops_cap=fcb, out_cap=ocb).vals, reps=2)
+
+# raw tile-level pieces at the same sizes, single tile
+at = tl.Tile(a.rows[0,0], a.cols[0,0], a.vals[0,0], a.nnz[0,0], a.tile_m, a.tile_n)
+bt = tl.Tile(bp.rows[0,0], bp.cols[0,0], bp.vals[0,0], bp.nnz[0,0], bp.tile_m, bp.tile_n)
+
+f_ranged = jax.jit(lambda at, bt: tl.spgemm_ranged(
+    S.PLUS_TIMES_F32, at, bt, a_lo=0, b_lo=0, length=a.tile_n,
+    flops_cap=fcb, out_cap=min(fcb, ocb)).vals)
+timeit("spgemm_ranged tile", lambda: f_ranged(at, bt), reps=2)
+
+# a sort benchmark at the expansion size
+key = jax.random.randint(jax.random.key(0), (fcb,), 0, 1 << 30, jnp.int32)
+val = jnp.ones((fcb,), jnp.float32)
+f_sort1 = jax.jit(lambda k, v: lax.sort((k, v), num_keys=1)[0] if False else None)
+from jax import lax
+f_sort = jax.jit(lambda k, v: lax.sort((k, v), num_keys=1))
+timeit(f"lax.sort 1key+1payload {fcb}", lambda: f_sort(key, val))
+f_sort3 = jax.jit(lambda k1, k2, v: lax.sort((k1, k2, v), num_keys=2))
+timeit(f"lax.sort 2key+1payload {fcb}", lambda: f_sort3(key, key, val))
+k64 = key.astype(jnp.int64)
+f_sort64 = jax.jit(lambda k, v: lax.sort((k, v), num_keys=1))
+timeit(f"lax.sort i64 1key+1payload {fcb}", lambda: f_sort64(k64, val))
+f_argsortg = jax.jit(lambda k, v: v[jnp.argsort(k)])
+timeit(f"argsort+gather {fcb}", lambda: f_argsortg(key, val))
+# gather at expansion size from a cap-size table
+idx = jax.random.randint(jax.random.key(1), (fcb,), 0, at.cap, jnp.int32)
+f_gather = jax.jit(lambda t, i: t[i])
+timeit(f"random gather {fcb} from {at.cap}", lambda: f_gather(at.vals, idx))
+sidx = jnp.sort(idx)
+timeit(f"sorted gather {fcb} from {at.cap}", lambda: f_gather(at.vals, sidx))

@@ -1,0 +1,113 @@
+"""Memory-scalable generation/ingestion (the DistEdgeList equivalent):
+chunked COO streaming must equal the one-shot global build, and the
+chunked R-MAT builder must produce a valid symmetric Graph500 matrix
+on a mesh (≅ DistEdgeList.cpp:223 + SparseCommon, SpParMat.cpp:2835)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid22():
+    return ProcGrid.make(2, 2, jax.devices()[:4])
+
+
+class TestChunkedBuild:
+    def test_chunks_equal_global(self, rng, grid22):
+        n = 50
+        m = 400
+        r = rng.integers(0, n, m).astype(np.int32)
+        c = rng.integers(0, n, m).astype(np.int32)
+        v = rng.random(m).astype(np.float32)
+        ref = DM.from_global_coo(S.PLUS, grid22, r, c, jnp.asarray(v), n, n)
+
+        nchunks = 5
+        w = m // nchunks
+
+        def chunk_fn(k):
+            return (jnp.asarray(r[k * w:(k + 1) * w]),
+                    jnp.asarray(c[k * w:(k + 1) * w]),
+                    jnp.asarray(v[k * w:(k + 1) * w]))
+
+        got = DM.from_coo_chunks(S.PLUS, grid22, chunk_fn, nchunks, n, n,
+                                 val_dtype=jnp.float32, cap=128)
+        np.testing.assert_allclose(DM.to_dense(got, 0.0),
+                                   DM.to_dense(ref, 0.0), rtol=1e-6)
+
+    def test_growth_replays_only_offending_chunk(self, rng, grid22):
+        # tiny initial cap forces the geometric growth path repeatedly
+        n = 40
+        m = 600
+        r = rng.integers(0, n, m).astype(np.int32)
+        c = rng.integers(0, n, m).astype(np.int32)
+        v = np.ones(m, np.float32)
+        ref = DM.from_global_coo(S.PLUS, grid22, r, c, jnp.asarray(v), n, n)
+        w = m // 3
+
+        def chunk_fn(k):
+            return (jnp.asarray(r[k * w:(k + 1) * w]),
+                    jnp.asarray(c[k * w:(k + 1) * w]),
+                    jnp.asarray(v[k * w:(k + 1) * w]))
+
+        got = DM.from_coo_chunks(S.PLUS, grid22, chunk_fn, 3, n, n,
+                                 val_dtype=jnp.float32, cap=1)
+        np.testing.assert_allclose(DM.to_dense(got, 0.0),
+                                   DM.to_dense(ref, 0.0), rtol=1e-6)
+
+    def test_rmat_chunked_mesh_scale12(self, grid22):
+        """Scale-12 symmetric build on the 4-device mesh in small
+        chunks: valid pattern-symmetric matrix, plausible size, BFS
+        runs on it (VERDICT round-3 'done' criterion, scaled to CI)."""
+        a = DM.from_rmat(S.LOR, grid22, jax.random.key(7), 12, 8,
+                         chunk_edges=1 << 13)   # 4 chunks
+        n = 1 << 12
+        assert (a.nrows, a.ncols) == (n, n)
+        nnz = a.getnnz()
+        # symmetrized dedup'd edge count: between m and 2m
+        assert 8 * n * 0.5 < nnz <= 2 * 8 * n
+        rr, cc, _ = DM.to_global_coo(a)
+        s1 = {(int(x), int(y)) for x, y in zip(rr, cc)}
+        assert all((y, x) in s1 for x, y in s1), "not symmetric"
+        from combblas_tpu.models import bfs as B
+        root = int(rr[0])
+        parents = B.bfs(a, jnp.int32(root))
+        p = np.asarray(parents.to_global())
+        assert p[root] == root and (p >= 0).sum() > 1
+
+    def test_no_phantom_on_nondividing_grid(self, rng):
+        """An out-of-range marker (the generator's overrun sentinel n)
+        must not survive as a phantom entry in the last block's padding
+        when grid dims don't divide n (round-4 review repro: 3x2 grid,
+        n=11 -> sentinel 11 lands at tile (2,1) local (3,5))."""
+        grid32 = ProcGrid.make(3, 2, jax.devices()[:6])
+        n = 11
+        r = np.array([1, 5, n], np.int32)   # last entry = invalid marker
+        c = np.array([2, 7, n], np.int32)
+        got = DM.from_coo_chunks(
+            S.PLUS, grid32, lambda k: (jnp.asarray(r), jnp.asarray(c),
+                                       jnp.ones(3, jnp.float32)),
+            1, n, n, val_dtype=jnp.float32, cap=128)
+        assert got.getnnz() == 2
+
+    def test_chunk_generator_covers_stream(self):
+        """Chunks tile the m-edge stream: total valid edge slots == m
+        even when m % nchunks != 0 (overrun marked out of range)."""
+        key = jax.random.key(3)
+        scale, ef, nchunks = 8, 7, 3          # m = 1792, mc = 598
+        n, m = 1 << scale, 7 << scale
+        tot = 0
+        for k in range(nchunks):
+            r, c = generate.rmat_edges_chunk(key, scale, ef,
+                                             jnp.int32(k), nchunks)
+            r = np.asarray(r)
+            valid = r < n
+            tot += int(valid.sum())
+            assert (np.asarray(c)[~valid] >= n).all()
+        assert tot == m

@@ -126,6 +126,61 @@ class TestPhased:
         np.testing.assert_allclose(DM.to_dense(c, 0.0), exp, rtol=1e-5)
 
 
+class TestPhased1x1:
+    """The single-tile fast path (plan-once + dynamic column windows +
+    tile.spgemm_colwindow) must agree with dense and with the mesh
+    path's semantics, including prune hooks and out_cap."""
+
+    @pytest.fixture(scope="class")
+    def grid11(self):
+        return ProcGrid.make(1, 1, jax.devices()[:1])
+
+    def test_matches_dense(self, rng, grid11):
+        da = random_sparse(rng, 30, 30, 0.4)
+        db = random_sparse(rng, 30, 30, 0.4)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        b = DM.from_dense(S.PLUS, grid11, db, 0.0)
+        for phases in (1, 3, 11):   # 11 > 8 exercises the mid-loop fold
+            c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, b, phases=phases)
+            np.testing.assert_allclose(DM.to_dense(c, 0.0), da @ db,
+                                       rtol=1e-5, err_msg=f"phases={phases}")
+
+    def test_autoselect_and_hook(self, rng, grid11):
+        da = random_sparse(rng, 16, 16, 0.6)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phase_flop_budget=32,
+                              prune_hook=_prune_small)
+        exp = da @ da
+        exp[exp < 0.2] = 0.0
+        np.testing.assert_allclose(DM.to_dense(c, 0.0), exp, rtol=1e-5)
+
+    def test_out_cap_respected(self, rng, grid11):
+        da = random_sparse(rng, 12, 12, 0.5)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2, out_cap=256)
+        assert c.cap == 256
+        np.testing.assert_allclose(DM.to_dense(c, 0.0), da @ da, rtol=1e-5)
+        with pytest.raises(ValueError, match="out_cap"):
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2, out_cap=2)
+
+    def test_colwindow_kernel(self, rng):
+        from combblas_tpu.ops import tile as tl
+        import jax.numpy as jnp
+        da = random_sparse(rng, 20, 20, 0.5)
+        db = random_sparse(rng, 20, 20, 0.5)
+        at = tl.from_dense(jnp.asarray(da), 0.0, 256)
+        bt = tl.from_dense(jnp.asarray(db), 0.0, 256)
+        full = np.zeros((20, 20), np.float32)
+        for lo, hi in ((0, 7), (7, 16), (16, 20)):
+            c = tl.spgemm_colwindow(
+                S.PLUS_TIMES_F32, at, bt, jnp.int32(lo), jnp.int32(hi),
+                flops_cap=4096, out_cap=512)
+            cd = np.asarray(tl.to_dense(c, jnp.float32(0.0)))
+            assert (cd[:, :lo] == 0).all() and (cd[:, hi:] == 0).all()
+            full += cd
+        np.testing.assert_allclose(full, da @ db, rtol=1e-5)
+
+
 class TestBlockDriver:
     def test_blocks_cover_product(self, rng, grid24):
         n = 24
