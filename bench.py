@@ -39,14 +39,21 @@ def bench_bfs(args):
 
 
 def bench_spgemm(args):
-    """R-MAT scale-S A*A via phased SUMMA; nnz(C)/sec/chip."""
+    """R-MAT scale-S A*A via phased SUMMA; nnz(C)/sec/chip. Also
+    reports the phase split (plan/local/merge — utils.timing GLOBAL,
+    stamped by the phased driver) and a phase-taxonomy SpMSpV probe
+    (fan_out/local/fan_in/merge, ≅ CombBLAS.h:78-100 TIMING)."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from combblas_tpu.ops import generate
     from combblas_tpu.ops import semiring as S
     from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel import distvec as dv
     from combblas_tpu.parallel import spgemm as spg
+    from combblas_tpu.parallel import spmv as spv
     from combblas_tpu.parallel.grid import ProcGrid
+    from combblas_tpu.utils import timing as tm
 
     grid = ProcGrid.make()
     n = 1 << args.spgemm_scale
@@ -59,14 +66,81 @@ def bench_spgemm(args):
     cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
                            phase_flop_budget=args.phase_flop_budget)
     cm.vals.block_until_ready()
+    tm.GLOBAL.totals.clear()
+    tm.GLOBAL.counts.clear()
     t0 = time.perf_counter()
     cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
                            phase_flop_budget=args.phase_flop_budget)
     cm.vals.block_until_ready()
     dt = time.perf_counter() - t0
     nnz = cm.getnnz()
+    spgemm_phases = tm.GLOBAL.report()
+    del cm
+
+    # SpMSpV phase probe (untimed vs the metric; ~5% random fringe)
+    tm.GLOBAL.totals.clear()
+    tm.GLOBAL.counts.clear()
+    fringe = np.zeros(grid.pr * a.tile_m, bool)
+    fringe[np.random.default_rng(0).choice(n, max(1, n // 20),
+                                           replace=False)] = True
+    y0 = dv.DistSpVec(
+        jnp.zeros((grid.pr, a.tile_m), jnp.float32),
+        jnp.asarray(fringe.reshape(grid.pr, a.tile_m)),
+        grid, "r", n)
+    for _ in range(3):
+        out = spv.spmsv_timed(S.PLUS_TIMES_F32, a, y0)
+        y0 = dv.DistSpVec(jnp.zeros_like(out.data),
+                          out.active, grid, out.axis, out.glen)
+    spmsv_phases = tm.GLOBAL.report()
+
     return {"scale": args.spgemm_scale, "c_nnz": nnz, "seconds": dt,
-            "nnz_per_sec_per_chip": nnz / dt / max(1, len(jax.devices()))}
+            "nnz_per_sec_per_chip": nnz / dt / max(1, len(jax.devices())),
+            "phases": spgemm_phases, "spmsv_phases": spmsv_phases}
+
+
+def bench_mcl(args):
+    """End-to-end MCL on a synthetic clustered graph with per-iteration
+    phase timing (≅ MCL.cpp's per-iteration stats)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.models import mcl as M
+    from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel.grid import ProcGrid
+    from combblas_tpu.utils import timing as tm
+
+    grid = ProcGrid.make()
+    n = 1 << args.mcl_scale
+    nclust = max(2, n // 64)
+    rng = np.random.default_rng(args.seed)
+    # planted partition: dense-ish blocks + sparse background
+    members = rng.integers(0, nclust, n)
+    m_intra = 16 * n
+    ra = rng.integers(0, n, m_intra)
+    # partner within the same cluster: walk to a random same-cluster node
+    order = np.argsort(members, kind="stable")
+    starts = np.searchsorted(members[order], np.arange(nclust + 1))
+    sz = np.maximum(starts[members[ra] + 1] - starts[members[ra]], 1)
+    cb = order[starts[members[ra]] + rng.integers(0, 2**31, m_intra) % sz]
+    m_bg = 2 * n
+    rb, cbg = rng.integers(0, n, m_bg), rng.integers(0, n, m_bg)
+    r = np.concatenate([ra, cb, rb, cbg]).astype(np.int32)
+    c = np.concatenate([cb, ra, cbg, rb]).astype(np.int32)
+    a = dm.from_global_coo(S.PLUS, grid, jnp.asarray(r), jnp.asarray(c),
+                           jnp.ones(len(r), jnp.float32), n, n)
+    jax.block_until_ready(a.rows)
+    tm.GLOBAL.totals.clear()
+    tm.GLOBAL.counts.clear()
+    t0 = time.perf_counter()
+    labels, nclusters, iters = M.mcl(
+        a, M.MclParams(max_iters=args.mcl_max_iters))
+    jax.block_until_ready(labels.data)
+    dt = time.perf_counter() - t0
+    return {"scale": args.mcl_scale, "n": n, "nnz": a.getnnz(),
+            "planted_clusters": nclust, "found_clusters": nclusters,
+            "iterations": iters, "seconds": round(dt, 3),
+            "phases": tm.GLOBAL.report()}
 
 
 def main():
@@ -88,6 +162,14 @@ def main():
     ap.add_argument("--phase-flop-budget", type=int, default=2 ** 26)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--skip-spgemm", action="store_true")
+    ap.add_argument("--skip-mcl", action="store_true")
+    ap.add_argument("--mcl-scale", type=int, default=13,
+                    help="MCL end-to-end bench: planted-partition graph "
+                         "with 2^scale vertices")
+    ap.add_argument("--mcl-max-iters", type=int, default=20)
+    ap.add_argument("--trace", metavar="LOGDIR", default=None,
+                    help="wrap the BFS bench in a jax.profiler trace "
+                         "(TensorBoard/xprof readable)")
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
@@ -102,7 +184,12 @@ def main():
     s = None
     while args.scale >= requested_scale - 6:
         try:
-            s = bench_bfs(args)
+            if args.trace:
+                from combblas_tpu.utils.timing import trace
+                with trace(args.trace):
+                    s = bench_bfs(args)
+            else:
+                s = bench_bfs(args)
             break
         except Exception as e:          # noqa: BLE001 — report, don't die
             msg = str(e).lower()
@@ -138,11 +225,27 @@ def main():
                 "unit": "nnz/s/chip",
                 "c_nnz": sp["c_nnz"],
                 "seconds": round(sp["seconds"], 3),
-                "note": f"largest feasible single-chip scale "
-                        f"{sp['scale']} (baseline metric names scale 22)",
+                "phases": sp["phases"],
+                "spmsv_phases": sp["spmsv_phases"],
+                "note": f"largest single-chip scale whose full C fits "
+                        f"HBM is {sp['scale']} (baseline metric names "
+                        "scale 22; scripts/spgemm_stream.py streams "
+                        "larger scales)",
             })
         except Exception as e:       # never lose the BFS headline
             extra.append({"metric": "spgemm_bench_error", "error": str(e)})
+    if not args.skip_mcl:
+        try:
+            mc = bench_mcl(args)
+            extra.append({
+                "metric": f"mcl_scale{mc['scale']}_end_to_end_seconds",
+                "value": mc["seconds"], "unit": "s",
+                **{k: mc[k] for k in ("n", "nnz", "planted_clusters",
+                                      "found_clusters", "iterations",
+                                      "phases")},
+            })
+        except Exception as e:
+            extra.append({"metric": "mcl_bench_error", "error": str(e)})
 
     print(json.dumps({
         "metric": f"graph500_bfs_scale{args.scale}_ef{args.edgefactor}_"

@@ -164,13 +164,23 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     hook = partial(mcl_prune_select_recover, p=params)
     it = 0
     nproc = a.grid.pr * a.grid.pc
+    from combblas_tpu.utils import timing as tm
+    t_ = tm.GLOBAL
     while ch > params.chaos_eps and it < params.max_iters:
-        a = spg.spgemm_phased(
-            S.PLUS_TIMES_F32, a, a, phases=params.phases,
-            phase_flop_budget=params.effective_flop_budget(nproc),
-            prune_hook=hook)
-        a = inflate(a, params.inflation)
-        ch = chaos(a)
+        # phase taxonomy stamped per iteration (≅ MCL.cpp's printed
+        # per-iteration stats; expansion's internal plan/local/prune/
+        # merge phases are stamped by the phased-SpGEMM driver)
+        with t_.phase("mcl_expand"):
+            a = spg.spgemm_phased(
+                S.PLUS_TIMES_F32, a, a, phases=params.phases,
+                phase_flop_budget=params.effective_flop_budget(nproc),
+                prune_hook=hook)
+            tm.sync(a.vals)
+        with t_.phase("mcl_inflate"):
+            a = inflate(a, params.inflation)
+            tm.sync(a.vals)
+        with t_.phase("mcl_chaos"):
+            ch = chaos(a)
         it += 1
         if verbose:
             print(f"mcl iter {it}: chaos {ch:.6f}, nnz {a.getnnz()}")

@@ -45,6 +45,7 @@ with a segmented scan) instead of gathered.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Optional
 
@@ -127,10 +128,13 @@ def empty(nrows: int, ncols: int, cap: int, dtype=jnp.float32) -> Tile:
 # ---------------------------------------------------------------------------
 
 def _sortable(vals: Array) -> tuple[Array, Any]:
-    """Cast values to a dtype `lax.sort`/Pallas handle on TPU (no i1
-    vector registers in Mosaic — memory: bool payloads miscompile)."""
+    """Bool values ride sorts as int8 (XLA sorts bool fine, but int8
+    keeps downstream where/fill uniform); the Pallas scan boundary
+    widens to int32 itself (no i1/i8 vector compute in Mosaic). The
+    narrow dtype matters: the chunked builder sorts half-billion-entry
+    merges, and an early int32 cast added 8 bytes/entry of footprint."""
     if vals.dtype == jnp.bool_:
-        return vals.astype(jnp.int32), jnp.bool_
+        return vals.astype(jnp.int8), jnp.bool_
     return vals, None
 
 
@@ -337,9 +341,33 @@ def seg_scan_values(monoid: Monoid, d2: Array, f2: Array) -> Array:
     path."""
     from combblas_tpu.ops import pallas_kernels as pk
     if pk.enabled() and not pk.is_batched(d2):
+        if d2.dtype in (jnp.bool_, jnp.int8):
+            # Mosaic has no i1 vregs and int8 vector compute is
+            # unreliable: widen to int32 at the kernel boundary only
+            # (cached wrapper: a per-call lambda would miss the
+            # compile cache on every call)
+            cmb, ident = _widened_combine(monoid, d2.dtype == jnp.bool_)
+            out = pk.seg_scan_values(d2.astype(jnp.int32), f2,
+                                     combine=cmb, ident_val=ident)
+            return out.astype(d2.dtype)
         return pk.seg_scan_values(d2, f2, combine=monoid.combine,
                                   ident_val=monoid.identity_scalar(d2.dtype))
     return seg_scan_core(monoid, d2, f2)[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _widened_combine(monoid: Monoid, from_bool: bool):
+    """int32-in/int32-out view of a bool/int8 monoid combine, for the
+    Pallas scan kernel (stable identity for compile-cache hits)."""
+    if from_bool:
+        def cmb(a, b):
+            return monoid.combine(a != 0, b != 0).astype(jnp.int32)
+        ident = int(bool(monoid.identity_scalar(jnp.bool_)))
+    else:
+        def cmb(a, b):
+            return monoid.combine(a, b).astype(jnp.int32)
+        ident = monoid.identity_scalar(jnp.int32)
+    return cmb, ident
 
 
 def _seg_scan_2d(monoid: Monoid, data: Array, starts: Array,

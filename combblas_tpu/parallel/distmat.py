@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from combblas_tpu.ops import tile as tl
@@ -171,10 +172,14 @@ def from_global_coo(add: Monoid, grid: ProcGrid, rows, cols, vals,
 
 
 @partial(jax.jit, static_argnames=("add", "grid", "nrows", "ncols",
-                                   "tile_m", "tile_n", "cap_out", "dedup"))
+                                   "tile_m", "tile_n", "cap_out", "dedup",
+                                   "banded"))
 def _merge_chunk(add: Monoid, grid: ProcGrid, acc_r, acc_c, acc_v, acc_n,
                  rows, cols, vals, nrows: int, ncols: int,
-                 tile_m: int, tile_n: int, cap_out: int, dedup: bool):
+                 tile_m: int, tile_n: int, cap_out: int, dedup: bool,
+                 banded: bool = False, band_lo=0, band_hi=0):
+    # band bounds are TRACED so all bands of one cap bucket share one
+    # compiled program (a static band tuple would compile per band)
     """Fold one global-coordinate COO chunk into the per-tile
     accumulators: per tile, concat (acc live prefix sentinels intact) +
     the chunk's owned entries, one sort_compress. Returns the new
@@ -190,6 +195,9 @@ def _merge_chunk(add: Monoid, grid: ProcGrid, acc_r, acc_c, acc_v, acc_n,
         # last block's PADDING and would survive as a phantom entry
         inb = (rows >= 0) & (rows < nrows) & (cols >= 0) & (cols < ncols)
         mine = inb & (rows // tile_m == i) & (cols // tile_n == j)
+        if banded:
+            lrow = rows - i * tile_m
+            mine = mine & (lrow >= band_lo) & (lrow < band_hi)
         lr = jnp.where(mine, rows - i * tile_m, tile_m)
         lc = jnp.where(mine, cols - j * tile_n, tile_n)
         crr = jnp.concatenate([ar, lr])
@@ -212,18 +220,24 @@ def _merge_chunk(add: Monoid, grid: ProcGrid, acc_r, acc_c, acc_v, acc_n,
     # builder exists for
     shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
     shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
-    from jax import lax as _lax
-    return (_lax.with_sharding_constraint(r.reshape(pr, pc, cap_out), shard3),
-            _lax.with_sharding_constraint(c.reshape(pr, pc, cap_out), shard3),
-            _lax.with_sharding_constraint(v.reshape(pr, pc, cap_out), shard3),
-            _lax.with_sharding_constraint(n.reshape(pr, pc), shard2),
+    return (lax.with_sharding_constraint(r.reshape(pr, pc, cap_out), shard3),
+            lax.with_sharding_constraint(c.reshape(pr, pc, cap_out), shard3),
+            lax.with_sharding_constraint(v.reshape(pr, pc, cap_out), shard3),
+            lax.with_sharding_constraint(n.reshape(pr, pc), shard2),
             full.reshape(pr, pc))
+
+
+#: per-band sort budget for the chunked builder: merges above this
+#: slot count compile sort programs whose buffers exceed HBM (the
+#: scale-24 single-band merge crashed the TPU compile helper)
+_BAND_SLOTS = 1 << 26
 
 
 def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
                     nrows: int, ncols: int, *, val_dtype=jnp.bool_,
                     cap: Optional[int] = None, dedup: bool = True,
-                    est_total: Optional[int] = None) -> DistSpMat:
+                    est_total: Optional[int] = None,
+                    row_bands: Optional[int] = None) -> DistSpMat:
     """Build a DistSpMat from a chunked COO stream without ever
     materializing the global edge list (≅ the DistEdgeList model:
     per-rank generation + SparseCommon shuffle, DistEdgeList.cpp:223 +
@@ -236,6 +250,14 @@ def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
     fold compiles once per capacity bucket; the capacity grows
     geometrically on overflow (one scalar readback per chunk) and only
     the offending chunk re-merges.
+
+    ``row_bands`` splits each tile's row space into ascending bands
+    with independent accumulators, bounding every merge sort to
+    (band_cap + chunk) slots; the final tile is assembled with
+    ascending dynamic_update_slice writes (each band's garbage tail is
+    overwritten by the next band's live prefix) — no global sort ever
+    runs, which is what lets a scale-24 matrix (~0.5G entries) build
+    on one 16 GB chip. Default: auto from the capacity estimate.
     """
     pr, pc = grid.pr, grid.pc
     tile_m = _ceil_div(nrows, pr)
@@ -244,47 +266,132 @@ def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
         est = est_total if est_total is not None else 0
         cap = max(1024, _ceil_div(est, pr * pc))
     cap = -(-cap // 128) * 128
+    if row_bands is None:
+        row_bands = max(1, _ceil_div(cap, _BAND_SLOTS))
+    row_bands = min(row_bands, tile_m)
+    band_m = _ceil_div(tile_m, row_bands)
+    bands = [(b * band_m, min((b + 1) * band_m, tile_m))
+             for b in range(row_bands)]
+    caps = [_qbucket(_ceil_div(cap, row_bands))] * row_bands
 
-    acc = None
+    shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
+    shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
+
+    def fresh(c):
+        return (jax.device_put(
+                    jnp.full((pr, pc, c), tile_m, jnp.int32), shard3),
+                jax.device_put(
+                    jnp.full((pr, pc, c), tile_n, jnp.int32), shard3),
+                jax.device_put(jnp.zeros((pr, pc, c), val_dtype), shard3),
+                jax.device_put(jnp.zeros((pr, pc), jnp.int32), shard2))
+
+    accs: list = [None] * row_bands
     for k in range(nchunks):
         rows, cols, vals = chunk_fn(k)
         rows = jnp.asarray(rows, jnp.int32)
         cols = jnp.asarray(cols, jnp.int32)
         vals = jnp.asarray(vals, val_dtype)
-        if acc is None:
-            shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
-            shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
-            acc = (jax.device_put(
-                       jnp.full((pr, pc, cap), tile_m, jnp.int32), shard3),
-                   jax.device_put(
-                       jnp.full((pr, pc, cap), tile_n, jnp.int32), shard3),
-                   jax.device_put(
-                       jnp.zeros((pr, pc, cap), val_dtype), shard3),
-                   jax.device_put(jnp.zeros((pr, pc), jnp.int32), shard2))
-        prev = acc
-        out = _merge_chunk(add, grid, *acc, rows, cols, vals,
-                           nrows, ncols, tile_m, tile_n, cap, dedup)
-        max_full = int(np.asarray(out[4]).max())
-        if max_full > cap:
-            # grow with headroom for the remaining stream and re-merge
-            # THIS chunk only (prev acc is untouched)
-            frac = (k + 1) / nchunks
-            cap = -(-int(max_full / frac * 1.1) // 128) * 128
-            prev = tuple(
-                _grow_stack(x, cap, fill)
-                for x, fill in zip(prev[:3], (tile_m, tile_n, None))
-            ) + (prev[3],)
+        # bands run SEQUENTIALLY and each replaces its accumulator
+        # before the next starts: batching all bands' merges first
+        # would hold old+new accumulators for every band at once —
+        # 2x the matrix footprint — and OOM'd the scale-24 build
+        for b, band in enumerate(bands):
+            if accs[b] is None:
+                accs[b] = fresh(caps[b])
+            prev = accs[b]
+            bkw = dict(banded=row_bands > 1,
+                       band_lo=jnp.int32(band[0]),
+                       band_hi=jnp.int32(band[1]))
             out = _merge_chunk(add, grid, *prev, rows, cols, vals,
-                               nrows, ncols, tile_m, tile_n, cap, dedup)
-            assert int(np.asarray(out[4]).max()) <= cap
-        acc = out[:4]
+                               nrows, ncols, tile_m, tile_n, caps[b],
+                               dedup, **bkw)
+            max_full = int(np.asarray(out[4]).max())
+            if max_full > caps[b]:
+                # grow with headroom for the remaining stream (quarter-
+                # octave bucket: bands land on shared compile shapes)
+                # and re-merge THIS chunk only (prev acc is untouched)
+                frac = (k + 1) / nchunks
+                caps[b] = _qbucket(int(max_full / frac * 1.1))
+                prev = tuple(
+                    _grow_stack(x, caps[b], fill)
+                    for x, fill in zip(prev[:3], (tile_m, tile_n, None))
+                ) + (prev[3],)
+                out = _merge_chunk(add, grid, *prev, rows, cols, vals,
+                                   nrows, ncols, tile_m, tile_n, caps[b],
+                                   dedup, **bkw)
+                assert int(np.asarray(out[4]).max()) <= caps[b]
+            accs[b] = out[:4]
+            del prev, out
 
+    if row_bands == 1:
+        acc = accs[0]
+        return DistSpMat(acc[0], acc[1], acc[2], acc[3],
+                         grid, nrows, ncols, tile_m, tile_n)
+    r, c, v, n = _assemble_bands(grid, accs, tile_m, tile_n)
+    return DistSpMat(r, c, v, n, grid, nrows, ncols, tile_m, tile_n)
+
+
+@partial(jax.jit, static_argnames=("grid", "tile_m", "tile_n"))
+def _assemble_bands(grid: ProcGrid, accs, tile_m: int, tile_n: int):
+    """Concatenate per-band accumulators into one padded sorted tile:
+    ascending dynamic_update_slice at the running live offset — band
+    b+1's write lands exactly where band b's live prefix ends, erasing
+    band b's sentinel tail; a final sentinel write cleans the last
+    band's tail. Sortedness is free (bands are ascending row ranges)."""
+    pr, pc = grid.pr, grid.pc
+    total_cap = sum(a[0].shape[-1] for a in accs)
+
+    def one(parts):
+        outr = jnp.full((total_cap,), tile_m, jnp.int32)
+        outc = jnp.full((total_cap,), tile_n, jnp.int32)
+        outv = jnp.zeros((total_cap,), parts[0][2].dtype)
+        off = jnp.zeros((), jnp.int32)
+        for (br, bc, bv, bn) in parts:
+            outr = lax.dynamic_update_slice(outr, br, (off,))
+            outc = lax.dynamic_update_slice(outc, bc, (off,))
+            outv = lax.dynamic_update_slice(outv, bv, (off,))
+            off = off + bn
+        # erase the last band's garbage tail with one mask pass (an
+        # update_slice would clamp near the end and clobber live data)
+        k = jnp.arange(total_cap, dtype=jnp.int32)
+        live = k < off
+        outr = jnp.where(live, outr, tile_m)
+        outc = jnp.where(live, outc, tile_n)
+        outv = jnp.where(live, outv, jnp.zeros((), outv.dtype))
+        return outr, outc, outv, off
+
+    rs, cs, vs, ns = [], [], [], []
+    for i in range(pr):
+        for j in range(pc):
+            parts = [(a[0][i, j], a[1][i, j], a[2][i, j], a[3][i, j])
+                     for a in accs]
+            r_, c_, v_, n_ = one(parts)
+            rs.append(r_)
+            cs.append(c_)
+            vs.append(v_)
+            ns.append(n_)
     shard3 = grid.sharding(ROW_AXIS, COL_AXIS, None)
     shard2 = grid.sharding(ROW_AXIS, COL_AXIS)
-    return DistSpMat(
-        jax.device_put(acc[0], shard3), jax.device_put(acc[1], shard3),
-        jax.device_put(acc[2], shard3), jax.device_put(acc[3], shard2),
-        grid, nrows, ncols, tile_m, tile_n)
+    r = lax.with_sharding_constraint(
+        jnp.stack(rs).reshape(pr, pc, total_cap), shard3)
+    c = lax.with_sharding_constraint(
+        jnp.stack(cs).reshape(pr, pc, total_cap), shard3)
+    v = lax.with_sharding_constraint(
+        jnp.stack(vs).reshape(pr, pc, total_cap), shard3)
+    n = lax.with_sharding_constraint(
+        jnp.stack(ns).reshape(pr, pc), shard2)
+    return r, c, v, n
+
+
+def _qbucket(x: int) -> int:
+    """Quarter-octave, 128-aligned capacity bucket: bands/regrowths
+    land on few distinct compile shapes (2^k * {1, 1.25, 1.5, 1.75})."""
+    x = max(x, 128)
+    k = (x - 1).bit_length() - 1
+    base = 1 << k
+    step = max(base // 4, 128)
+    out = base if x <= base else base + step * (-(-(x - base) // step))
+    return -(-out // 128) * 128
 
 
 def _grow_stack(x, new_cap, fill):
@@ -320,9 +427,12 @@ def from_rmat(add: Monoid, grid: ProcGrid, key, scale: int,
         return r, c, jnp.ones_like(r, val_dtype)
 
     sym_m = 2 * m if symmetrize else m
+    # Graph500 R-MAT dedup removes only ~4-5% at ef16 (measured: scale
+    # 22 sym keeps 128.3M of 134.2M); a tight estimate avoids capacity
+    # growth, whose re-merge recompile costs ~30s per new bucket
     return from_coo_chunks(add, grid, chunk_fn, nchunks, n, n,
                            val_dtype=val_dtype, cap=cap, dedup=dedup,
-                           est_total=int(sym_m * 0.75))
+                           est_total=int(sym_m * 0.98))
 
 
 def from_dense(add: Monoid, grid: ProcGrid, dense, zero,

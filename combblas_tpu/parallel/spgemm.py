@@ -367,14 +367,17 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     planning, no B-window materialization, no device_put round-trips —
     the round-3 path spent ~10x the kernel time on those.
     """
+    from combblas_tpu.utils import timing as tm
+    t_ = tm.GLOBAL
     grid = a.grid
     at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
                  a.tile_m, a.tile_n)
     bt = tl.Tile(b.rows[0, 0], b.cols[0, 0], b.vals[0, 0], b.nnz[0, 0],
                  b.tile_m, b.tile_n)
-    windows = plan_colwindows(a, b, phases=phases,
-                              phase_flop_budget=phase_flop_budget,
-                              cap_round=cap_round)
+    with t_.phase("spgemm_plan"):
+        windows = plan_colwindows(a, b, phases=phases,
+                                  phase_flop_budget=phase_flop_budget,
+                                  cap_round=cap_round)
 
     def wrap(t: tl.Tile) -> DistSpMat:
         return DistSpMat(t.rows[None, None], t.cols[None, None],
@@ -398,20 +401,26 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         return t
 
     for (lo, hi, fc, oc) in windows:
-        cp = tl.spgemm_colwindow(
-            sr, at, bt, jnp.asarray(lo, jnp.int32), jnp.asarray(hi, jnp.int32),
-            flops_cap=fc, out_cap=oc)
+        with t_.phase("local"):
+            cp = tl.spgemm_colwindow(
+                sr, at, bt, jnp.asarray(lo, jnp.int32),
+                jnp.asarray(hi, jnp.int32), flops_cap=fc, out_cap=oc)
         if prune_hook is not None:
-            cp = _unwrap_1x1(prune_hook(wrap(cp)))
+            with t_.phase("prune"):
+                cp = _unwrap_1x1(prune_hook(wrap(cp)))
         # shrink to the true output size: out_cap above is flops-bounded
         # (~2-4x the deduped nnz on power-law graphs), and holding
         # several flops-sized parts OOMs the 16 GB HBM at scale >= 16.
         # One scalar readback per phase buys a bounded working set.
-        cp = cp.with_capacity(_bucket_fine(int(np.asarray(cp.nnz)), 128))
+        with t_.phase("local"):
+            cp = cp.with_capacity(_bucket_fine(int(np.asarray(cp.nnz)), 128))
         parts.append(cp)
         if len(parts) >= 8:
-            parts = [fold(parts, None)]
-    out = parts[0] if len(parts) == 1 else fold(parts, None)
+            with t_.phase("merge"):
+                parts = [fold(parts, None)]
+    with t_.phase("merge"):
+        out = parts[0] if len(parts) == 1 else fold(parts, None)
+        tm.sync(out.rows)
     if out_cap is not None and out.cap != out_cap:
         need = int(np.asarray(out.nnz))
         if out_cap < need:

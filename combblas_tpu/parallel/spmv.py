@@ -92,6 +92,88 @@ def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
     return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)
 
 
+@partial(jax.jit, static_argnames=("sr",))
+def _spmsv_local(sr: Semiring, a: DistSpMat, x: DistSpVec):
+    """LocalSpMV only: per-tile partials, NO cross-device reduction —
+    the 'local' phase of the instrumented path."""
+    mesh = a.grid.mesh
+
+    def f(rows, cols, vals, nnz, xb, actb):
+        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                    a.tile_m, a.tile_n)
+        y, hits = tl.spmv_masked_hits(sr, t, xb[0], actb[0])
+        return y[None, None], hits[None, None]
+
+    return jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 3
+                 + (P(ROW_AXIS, COL_AXIS), P(COL_AXIS, None),
+                    P(COL_AXIS, None)),
+        out_specs=(P(ROW_AXIS, COL_AXIS, None),) * 2,
+    )(a.rows, a.cols, a.vals, a.nnz, x.data, x.active)
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def _spmsv_fanin(sr: Semiring, a: DistSpMat, yp, hp):
+    """Fan-in only: the monoid collective along the row's devices (≅
+    Alltoallv + MergeContributions, ParFriends.h:1832/1629 — one
+    XLA collective on ICI)."""
+    mesh = a.grid.mesh
+
+    def f(yb, hb):
+        y = sr.add.axis_reduce(yb[0, 0], COL_AXIS)
+        hits = lax.pmax(hb[0, 0].astype(jnp.int32), COL_AXIS) > 0
+        return y[None], hits[None]
+
+    data, active = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 2,
+        out_specs=(P(ROW_AXIS, None),) * 2,
+    )(yp, hp)
+    return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)
+
+
+def spmsv_timed(sr: Semiring, a: DistSpMat, y_prev: DistSpVec,
+                timers=None) -> DistSpVec:
+    """SpMSpV with the reference's phase taxonomy stamped (CombBLAS.h
+    TIMING accumulators around ParFriends.h:1743-1879): takes the
+    ROW-aligned previous output, realigns it to column alignment
+    (fan_out ≅ TransposeVector + AllGatherVector), runs the local
+    kernel, then the fan-in collective. Each phase is a separate
+    dispatch blocked to completion, so the split is honest wall-clock
+    (the fused `spmsv` is faster — use this for attribution, not
+    production). Stamps utils.timing.GLOBAL unless ``timers`` given.
+    """
+    from combblas_tpu.utils import timing as tm
+    t = timers if timers is not None else tm.GLOBAL
+    was = tm.enabled()
+    tm.set_enabled(True)   # this entry point EXISTS for attribution
+    try:
+        with t.phase("fan_out"):
+            xd = realign(y_prev.dense, COL_AXIS, block=a.tile_n,
+                         fill=sr.zero())
+            xa = realign(DistVec(y_prev.active, y_prev.grid, y_prev.axis,
+                                 y_prev.glen),
+                         COL_AXIS, block=a.tile_n, fill=False)
+            x = DistSpVec(xd.data, xa.data, a.grid, COL_AXIS, a.ncols)
+            tm.sync(x.data)   # value readback: block_until_ready can
+            #                   ack early on remote-TPU relays
+        with t.phase("local"):
+            yp, hp = _spmsv_local(sr, a, x)
+            tm.sync(yp)
+        with t.phase("fan_in"):
+            out = _spmsv_fanin(sr, a, yp, hp)
+            tm.sync(out.data)
+    finally:
+        tm.set_enabled(was)
+    # 'merge' is fused into the fan-in collective on TPU (the monoid
+    # psum/pmax IS MergeContributions); stamp a zero-cost marker so
+    # reports carry the full taxonomy
+    with t.phase("merge"):
+        pass
+    return out
+
+
 @jax.jit
 def est_spmsv_nnz(a: DistSpMat, x_active) -> jax.Array:
     """Estimate (here: exact count of) the output nonzeros of an

@@ -66,6 +66,42 @@ class Timers:
                   f"  ({v['mean_ms']:.3f} ms/call)")
 
 
+#: process-wide accumulators, stamped by the instrumented drivers
+#: (spmv.spmsv_timed, spgemm's phased paths, models.mcl) — the
+#: cblas_* globals analogue. Callers snapshot/reset around a region:
+#:     GLOBAL.totals.clear(); GLOBAL.counts.clear()
+GLOBAL = Timers()
+
+#: phase SYNC gate (≅ compiling the reference with -DTIMING): when
+#: off (default), instrumented drivers stamp dispatch-time only and
+#: skip their forced device syncs — production calls pay nothing.
+_ENABLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def set_enabled(on: bool) -> None:
+    global _ENABLED
+    _ENABLED = on
+
+
+def sync(x) -> None:
+    """Force completion with a tiny data-DEPENDENT readback: on
+    remote-TPU relays block_until_ready can ack before execution
+    finishes, so honest phase boundaries fetch a value (one element,
+    via a device-side slice — not the whole array). No-op when phase
+    timing is disabled."""
+    if not _ENABLED:
+        return
+    import numpy as np
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
+            np.asarray(leaf.ravel()[0])
+            return
+
+
 @contextlib.contextmanager
 def trace(logdir: str):
     """jax.profiler trace context — the XLA-level phase breakdown

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Per-level cost breakdown of the edge-space bit BFS on the real
+chip: for each level, frontier size (bits), route time, scan time.
+Guides the direction-optimization / mask-compaction decision.
+
+Usage: python scripts/profile_bfs_levels.py [scale] [nroots]
+"""
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.models import bfs as B
+from combblas_tpu.ops import bitseg as bs
+from combblas_tpu.ops import route as rt
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    nroots = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    stats = None
+    from combblas_tpu.ops import generate
+    n = 1 << scale
+    r, c = generate.rmat_edges(jax.random.key(1), scale, 16)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, grid, r, c, jnp.ones_like(r, jnp.bool_),
+                           n, n, cap=int(0.98 * (r.shape[0])))
+    del r, c
+    jax.block_until_ready(a.rows)
+    t0 = time.perf_counter()
+    plan = B.plan_bfs(a, route=True)
+    jax.block_until_ready(plan.crows)
+    print(f"# plan: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    cap = a.cap
+    npad = plan.route_masks.shape[-1] * 32
+    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad)
+    sb = plan.starts_bits[0, 0]
+    vb = plan.valid_bits[0, 0]
+    rstarts = plan.rstarts[0, 0]
+
+    route_j = jax.jit(lambda w: rt.apply_route_best(rp, w))
+    fill_j = jax.jit(lambda x: bs.seg_or_fill_best(x, sb))
+
+    @jax.jit
+    def level_rest(eact, visited, pcand):
+        hit = eact & vb
+        reached = fill_j(hit)
+        new2 = reached & ~visited & vb
+        return new2, visited | new2, pcand | (hit & new2)
+
+    @jax.jit
+    def popcount(w):
+        return jnp.sum(jax.lax.population_count(w).astype(jnp.int32))
+
+    deg = B.row_degrees(a)
+    degv = np.asarray(deg.reshape(-1))
+    roots = np.nonzero(degv > 0)[0][:nroots]
+
+    # row_run_bits equivalent on host side via jitted helper
+    nwords = npad >> 5
+
+    @jax.jit
+    def root_bits(root):
+        lo, hi = rstarts[root], rstarts[root + 1]
+        w32 = jnp.arange(nwords, dtype=jnp.int32) * 32
+        x_hi = jnp.clip(hi - w32, 0, 32)
+        x_lo = jnp.clip(lo - w32, 0, 32)
+
+        def msk(x):
+            full = jnp.uint32(0xFFFFFFFF)
+            part = (jnp.uint32(1) << jnp.clip(x, 0, 31).astype(
+                jnp.uint32)) - jnp.uint32(1)
+            return jnp.where(x >= 32, full, part)
+
+        return msk(x_hi) & ~msk(x_lo)
+
+    for root in roots:
+        new = root_bits(jnp.int32(int(root)))
+        visited = new
+        pcand = jnp.zeros_like(new)
+        lvl = 0
+        print(f"root {root}:", flush=True)
+        while True:
+            nb = int(np.asarray(popcount(new)))
+            if nb == 0 or lvl > 40:
+                break
+            t0 = time.perf_counter()
+            eact = route_j(new)
+            _ = int(np.asarray(popcount(eact)))
+            t_route = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            new, visited, pcand = level_rest(eact, visited, pcand)
+            nb2 = int(np.asarray(popcount(new)))
+            t_rest = time.perf_counter() - t0
+            print(f"  lvl {lvl}: frontier_bits={nb} route={t_route*1e3:.1f}ms"
+                  f" scans={t_rest*1e3:.1f}ms next={nb2}", flush=True)
+            lvl += 1
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,30 @@ class TestKselect:
             exp = np.sort(cv)[-k] if len(cv) >= k else -1.0
             assert got[j] == pytest.approx(exp), f"col {j}"
 
+    def test_kselect_iterative_tall_grid_negatives(self, rng):
+        """The O(cap)-memory iterative selection (pr>1 bisection on
+        uint32 keys) must be exact on negative/mixed floats and on a
+        tall 8x1 grid, and kselect2 on a wide 1x8 grid."""
+        import jax
+        g81 = ProcGrid.make(8, 1, jax.devices())
+        n = 41
+        d = rng.standard_normal((n, n)).astype(np.float32)
+        d[rng.random((n, n)) > 0.4] = 0.0
+        a = dm.from_dense(S.PLUS, g81, d, 0.0)
+        for k in (1, 2, 5):
+            got = alg.kselect1(a, k, fill=np.float32(-99.0)).to_global()
+            for j in range(n):
+                cv = d[:, j][d[:, j] != 0]
+                exp = np.sort(cv)[-k] if len(cv) >= k else -99.0
+                assert got[j] == pytest.approx(exp), f"k={k} col {j}"
+        g18 = ProcGrid.make(1, 8, jax.devices())
+        a2 = dm.from_dense(S.PLUS, g18, d, 0.0)
+        got2 = alg.kselect2(a2, 2, fill=np.float32(0.0)).to_global()
+        for i in range(n):
+            rv = d[i][d[i] != 0]
+            exp = np.sort(rv)[-2] if len(rv) >= 2 else 0.0
+            assert got2[i] == pytest.approx(exp), f"row {i}"
+
     def test_global_topk_prune(self, rng, grid):
         a, d = _dist(rng, grid, density=0.6)
         k = 4

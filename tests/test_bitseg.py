@@ -9,6 +9,8 @@ import pytest
 from combblas_tpu.ops import bitseg as BS
 from combblas_tpu.ops import route as R
 
+pytestmark = pytest.mark.quick  # core-correctness fast subset
+
 
 def _segments(starts_bool):
     seg = np.cumsum(starts_bool.astype(np.int64)) - 1

@@ -13,6 +13,8 @@ from combblas_tpu.ops import semiring as S
 from combblas_tpu.parallel import distmat as DM
 from combblas_tpu.parallel.grid import ProcGrid
 
+pytestmark = pytest.mark.quick  # core-correctness fast subset
+
 
 @pytest.fixture(scope="module")
 def grid22():
@@ -80,6 +82,37 @@ class TestChunkedBuild:
         parents = B.bfs(a, jnp.int32(root))
         p = np.asarray(parents.to_global())
         assert p[root] == root and (p >= 0).sum() > 1
+
+    def test_row_bands_equal_single_band(self, rng, grid22):
+        """Banded accumulation (bounded merge sorts + ascending
+        dynamic_update_slice assembly) must equal the 1-band build,
+        including with growth from a tiny cap."""
+        n = 64
+        m = 900
+        r = rng.integers(0, n, m).astype(np.int32)
+        c = rng.integers(0, n, m).astype(np.int32)
+        v = rng.random(m).astype(np.float32)
+        ref = DM.from_global_coo(S.PLUS, grid22, r, c, jnp.asarray(v), n, n)
+        w = m // 4
+
+        def chunk_fn(k):
+            return (jnp.asarray(r[k * w:(k + 1) * w]),
+                    jnp.asarray(c[k * w:(k + 1) * w]),
+                    jnp.asarray(v[k * w:(k + 1) * w]))
+
+        for bands, cap in ((3, 256), (5, 2)):   # cap=2 forces growth
+            got = DM.from_coo_chunks(S.PLUS, grid22, chunk_fn, 4, n, n,
+                                     val_dtype=jnp.float32, cap=cap,
+                                     row_bands=bands)
+            np.testing.assert_allclose(DM.to_dense(got, 0.0),
+                                       DM.to_dense(ref, 0.0), rtol=1e-6,
+                                       err_msg=f"bands={bands} cap={cap}")
+            # tile invariant: sorted, sentinel-padded
+            t = got.tile_at(0, 1)
+            rr = np.asarray(t.rows)
+            k = int(np.asarray(t.nnz))
+            assert (np.diff(rr[:k]) >= 0).all()
+            assert (rr[k:] == t.nrows).all()
 
     def test_no_phantom_on_nondividing_grid(self, rng):
         """An out-of-range marker (the generator's overrun sentinel n)

@@ -8,6 +8,8 @@ import pytest
 from combblas_tpu.ops import tile as T
 from combblas_tpu.ops import semiring as S
 
+pytestmark = pytest.mark.quick  # core-correctness fast subset
+
 
 def random_sparse(rng, m, n, density=0.2, dtype=np.float32):
     dense = rng.random((m, n)).astype(dtype)

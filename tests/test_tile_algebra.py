@@ -10,6 +10,8 @@ from combblas_tpu.ops import semiring as S
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops import tile_algebra as ta
 
+pytestmark = pytest.mark.quick  # core-correctness fast subset
+
 
 def _rand_tile(rng, nrows=13, ncols=11, density=0.3, cap=None, ints=False):
     dense = rng.random((nrows, ncols), dtype=np.float32)

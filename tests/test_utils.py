@@ -7,6 +7,8 @@ import pytest
 from combblas_tpu.utils import Timers, PHASES, parse_cli
 from combblas_tpu.utils.config import BfsConfig, SpGemmBenchConfig
 
+pytestmark = pytest.mark.quick  # core-correctness fast subset
+
 
 class TestTimers:
     def test_accumulates(self):
