@@ -659,6 +659,15 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
 
         return msk(x_hi) & ~msk(x_lo)
 
+    # NB round-4 lesson (measured, scale 22): a direction-optimizing
+    # sparse/dense hybrid of this loop is a LOSS on this hardware —
+    # any vertex-granular step costs cap-sized unpacks or tile_m-sized
+    # gathers (~10-40 ms) against a dense level's ~15 ms, and the
+    # sparse<->dense transitions must reconstruct row-filled frontier/
+    # visited bits (seed+fill each). The tried hybrid ran 3.6x slower
+    # (69 vs 256 MTEPS). The uniform edge-space loop below is the fast
+    # form; light levels' route+fill (~30% of a root) are already
+    # near the packed-word cost floor.
     new0 = row_run_bits(root)
     visited0 = new0
     pcand0 = jnp.zeros_like(new0)
@@ -1080,33 +1089,45 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     # the edge-space bit BFS is the fast path when it applies: routed
     # plan + single tile (symmetric adjacency — Graph500 graphs are),
     # or routed plan + square mesh (the distributed variant, which
-    # needs no symmetry)
+    # needs no symmetry). NB: kernels take (a, plan, root) as ARGS —
+    # closing over the committed matrix would inline it as jaxpr
+    # constants (per-call re-upload / oversized HLO on remote TPUs).
     if plan.starts_bits is not None and grid.pr == 1 and grid.pc == 1:
-        run_one = lambda rt_: bfs_bits(a, jnp.int32(rt_), plan)  # noqa: E731
+        kernel = lambda a_, p_, r_: bfs_bits(a_, r_, p_)  # noqa: E731
         if verbose:
             print("kernel: edge-space bit BFS", flush=True)
     elif _bits_mesh_ok(a, plan):
-        run_one = lambda rt_: bfs_bits_mesh(a, jnp.int32(rt_), plan)  # noqa: E731
+        kernel = lambda a_, p_, r_: bfs_bits_mesh(a_, r_, p_)  # noqa: E731
         if verbose:
             print("kernel: distributed edge-space bit BFS", flush=True)
     else:
-        run_one = lambda rt_: bfs(a, jnp.int32(rt_), plan,  # noqa: E731
-                                  alpha=alpha)
+        kernel = lambda a_, p_, r_: bfs(a_, r_, p_, alpha=alpha)  # noqa: E731
 
     stats = BfsRunStats([], [], [])
+
+    # ONE dispatch + ONE readback per timed root: the traversal and
+    # its stats fuse into a single executable, and both stat scalars
+    # come back in one transfer — each extra dispatch/readback costs
+    # the full relay round trip (~85-120 ms) on tunneled TPUs, which
+    # at scale 22 was ~40% of the per-root time
+    @jax.jit
+    def run_with_stats(a_, plan_, deg_, rt_):
+        parents = kernel(a_, plan_, rt_)
+        visited_d, nedges_d = run_stats(deg_, parents)
+        return parents, jnp.stack([visited_d, nedges_d])
+
     # warm-up compile (not timed, like the reference's untimed iteration 0)
-    _ = np.asarray(run_stats(deg, run_one(roots[0]))[0])
+    _ = np.asarray(run_with_stats(a, plan, deg, jnp.int32(roots[0]))[1])
     for ri, root in enumerate(roots):
         # timed region ends at the scalar fetch: on remote backends
         # block_until_ready can ack before execution finishes, so the
         # honest timestamp is a value readback that depends on the
         # whole traversal
         t0 = time.perf_counter()
-        parents = run_one(root)
-        visited_d, nedges_d = run_stats(deg, parents)
-        nedges = int(np.asarray(nedges_d))
+        parents, vn = run_with_stats(a, plan, deg, jnp.int32(root))
+        vn = np.asarray(vn)
         dt = time.perf_counter() - t0
-        visited = int(np.asarray(visited_d))
+        visited, nedges = int(vn[0]), int(vn[1])
         if ri < validate_roots:
             if grid.pr == 1 and grid.pc == 1:
                 validate_bfs_on_device(a, plan, root, parents, deg)

@@ -257,6 +257,16 @@ def masked_reduce(monoid: Monoid, a: DistSpMat, dim: str, mask: DistVec,
 # SpParMat.cpp:1413)
 # ---------------------------------------------------------------------------
 
+def _bisectable(dtype) -> bool:
+    """Whether _kselect_axis's 32-bit order-isomorphic keys are exact
+    for this dtype: 64-bit values don't fit, and unsigned ints would
+    wrap through the signed cast before the sign-bit flip."""
+    dt = jnp.dtype(dtype)
+    if dt.itemsize > 4:
+        return False
+    return not jnp.issubdtype(dt, jnp.unsignedinteger)
+
+
 def _ordered_key(vals: Array) -> Array:
     """Order-isomorphic uint32 key: k(a) < k(b) iff a < b. Standard
     radix trick for floats (flip sign bit for positives, all bits for
@@ -402,7 +412,7 @@ def kselect1(a: DistSpMat, k, fill) -> DistVec:
     gather fallback.
     """
     if a.grid.pr > 1:
-        if jnp.dtype(a.dtype).itemsize > 4:
+        if not _bisectable(a.dtype):
             return _kselect_gather(a, k, fill, dim="col")
         return _kselect_axis(a, k, fill, dim="col")
     mesh = a.grid.mesh
@@ -431,7 +441,7 @@ def kselect2(a: DistSpMat, k, fill) -> DistVec:
     (nrows,) vector (≅ Kselect2, SpParMat.cpp:1413); the row-wise twin
     of `kselect1`."""
     if a.grid.pc > 1:
-        if jnp.dtype(a.dtype).itemsize > 4:
+        if not _bisectable(a.dtype):
             return _kselect_gather(a, k, fill, dim="row")
         return _kselect_axis(a, k, fill, dim="row")
     mesh = a.grid.mesh

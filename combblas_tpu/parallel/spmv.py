@@ -92,6 +92,18 @@ def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
     return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)
 
 
+@partial(jax.jit, static_argnames=("grid", "axis", "glen", "tile_n"))
+def _spmsv_fanout(grid, axis, glen, tile_n, data, active, zero):
+    """Jitted fan-out phase (≅ TransposeVector + AllGatherVector):
+    eager realign would dispatch op-by-op — each a full relay round
+    trip on tunneled TPUs, inflating the phase by 10x+."""
+    xd = realign(DistVec(data, grid, axis, glen), COL_AXIS,
+                 block=tile_n, fill=zero)
+    xa = realign(DistVec(active, grid, axis, glen), COL_AXIS,
+                 block=tile_n, fill=False)
+    return xd.data, xa.data
+
+
 @partial(jax.jit, static_argnames=("sr",))
 def _spmsv_local(sr: Semiring, a: DistSpMat, x: DistSpVec):
     """LocalSpMV only: per-tile partials, NO cross-device reduction —
@@ -150,12 +162,10 @@ def spmsv_timed(sr: Semiring, a: DistSpMat, y_prev: DistSpVec,
     tm.set_enabled(True)   # this entry point EXISTS for attribution
     try:
         with t.phase("fan_out"):
-            xd = realign(y_prev.dense, COL_AXIS, block=a.tile_n,
-                         fill=sr.zero())
-            xa = realign(DistVec(y_prev.active, y_prev.grid, y_prev.axis,
-                                 y_prev.glen),
-                         COL_AXIS, block=a.tile_n, fill=False)
-            x = DistSpVec(xd.data, xa.data, a.grid, COL_AXIS, a.ncols)
+            xdd, xad = _spmsv_fanout(
+                y_prev.grid, y_prev.axis, y_prev.glen, a.tile_n,
+                y_prev.data, y_prev.active, sr.zero())
+            x = DistSpVec(xdd, xad, a.grid, COL_AXIS, a.ncols)
             tm.sync(x.data)   # value readback: block_until_ready can
             #                   ack early on remote-TPU relays
         with t.phase("local"):

@@ -5,10 +5,11 @@ Guides the direction-optimization / mask-compaction decision.
 
 Usage: python scripts/profile_bfs_levels.py [scale] [nroots]
 """
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp

@@ -12,10 +12,11 @@ Prints one JSON line: {"scale": S, "c_nnz": N, "seconds": T,
 Usage: python scripts/spgemm_stream.py [scale] [edgefactor] [budget_log2]
 """
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
