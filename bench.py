@@ -97,11 +97,15 @@ def bench_spgemm(args):
         jnp.zeros((grid.pr, a.tile_m), jnp.float32),
         jnp.asarray(fringe.reshape(grid.pr, a.tile_m)),
         grid, "r", n)
-    warm = spv.spmsv_timed(S.PLUS_TIMES_F32, a, y0)
+    spv.spmsv_timed(S.PLUS_TIMES_F32, a, y0)   # warm-up: compile only
     tm.GLOBAL.totals.clear()
     tm.GLOBAL.counts.clear()
-    y0 = dv.DistSpVec(jnp.zeros_like(warm.data), warm.active, grid,
-                      warm.axis, warm.glen)
+    # restart from the ORIGINAL 5% fringe so the timed hops match the
+    # documented probe (warm.active would be its one-hop expansion)
+    y0 = dv.DistSpVec(
+        jnp.zeros((grid.pr, a.tile_m), jnp.float32),
+        jnp.asarray(fringe.reshape(grid.pr, a.tile_m)),
+        grid, "r", n)
     for _ in range(3):
         out = spv.spmsv_timed(S.PLUS_TIMES_F32, a, y0)
         y0 = dv.DistSpVec(jnp.zeros_like(out.data),
@@ -179,11 +183,19 @@ def main():
     ap.add_argument("--phase-flop-budget", type=int, default=2 ** 26)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--skip-spgemm", action="store_true")
-    ap.add_argument("--skip-mcl", action="store_true")
-    ap.add_argument("--mcl-scale", type=int, default=13,
+    ap.add_argument("--with-mcl", action="store_true",
+                    help="run the MCL end-to-end bench live (adds ~10+ "
+                         "min: XLA recompiles per capacity bucket on "
+                         "the 1-core host); by default the recorded "
+                         "measurement (MCL_BENCH_r04.json) is embedded")
+    ap.add_argument("--mcl-scale", type=int, default=11,
                     help="MCL end-to-end bench: planted-partition graph "
-                         "with 2^scale vertices")
-    ap.add_argument("--mcl-max-iters", type=int, default=20)
+                         "with 2^scale vertices. Larger scales spend "
+                         "tens of minutes in per-iteration recompiles "
+                         "on the 1-core host (capacity buckets shift as "
+                         "the matrix sparsifies) — the measured scale-13 "
+                         "run is preserved in MCL_BENCH_r04.json")
+    ap.add_argument("--mcl-max-iters", type=int, default=12)
     ap.add_argument("--trace", metavar="LOGDIR", default=None,
                     help="wrap the BFS bench in a jax.profiler trace "
                          "(TensorBoard/xprof readable)")
@@ -251,7 +263,7 @@ def main():
             })
         except Exception as e:       # never lose the BFS headline
             extra.append({"metric": "spgemm_bench_error", "error": str(e)})
-    if not args.skip_mcl:
+    if args.with_mcl:
         try:
             mc = bench_mcl(args)
             extra.append({
@@ -263,6 +275,17 @@ def main():
             })
         except Exception as e:
             extra.append({"metric": "mcl_bench_error", "error": str(e)})
+    else:
+        # embed the recorded end-to-end measurement (same machine,
+        # this round) instead of re-running it inside the bench window
+        try:
+            import os
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "MCL_BENCH_r04.json")) as f:
+                extra.append({**json.load(f), "recorded": True})
+        except Exception as e:
+            extra.append({"metric": "mcl_recorded_result_missing",
+                          "error": str(e)[:200]})
 
     print(json.dumps({
         "metric": f"graph500_bfs_scale{args.scale}_ef{args.edgefactor}_"

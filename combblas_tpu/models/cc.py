@@ -43,6 +43,15 @@ def fastsv(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
       3. aggressive hooking:  f[u]    <- min(f[u],    mngf[u])
       4. shortcutting:        f[u]    <- min(f[u],    gf[u])
       5. gf = f[f];  converged when gf stops changing.
+
+    Design note (deliberate divergence from the reference's
+    distributed Assign/Extract vector primitives, CC.h:420-1018): the
+    parent array rides the while_loop as a flat replicated (n,) int32
+    — the hooking indirections (f[f[u]]) become local gathers instead
+    of cross-rank Extract round trips. Per-device memory is O(n)
+    vertex state (4 bytes/vertex: 64 MB at scale 24, 1 GB at scale
+    28), a bound the 16 GB HBM accommodates through every Graph500
+    scale this framework targets; the O(nnz) edge work stays sharded.
     """
     if a.nrows != a.ncols:
         raise ValueError(

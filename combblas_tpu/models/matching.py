@@ -287,22 +287,27 @@ def auction_matching(a: dm.DistSpMat, eps: float = 1e-2,
                 return
             sv = np.where(np.isfinite(sv), sv, bv - e)
             incr = bv - sv + e
-            order = np.argsort(incr[bidders])    # ascending; later wins
-            rows = np.nonzero(bidders)[0][order]
-            winner = {}
-            for r in rows:
-                winner[int(bc[r])] = (int(r), float(incr[r]))
-            progressed = False
-            for c, (r, inc) in winner.items():
-                old = mcol[c]
-                if old >= 0:
-                    mrow[old] = -1
-                mrow[r] = c
-                mcol[c] = r
-                price[c] += inc
-                progressed = True
-            if not progressed:
+            # vectorized winner resolution (the round-3 per-column dict
+            # loop was O(#bidders) Python per round): each column takes
+            # its max bid, ties to the larger row — safe to apply in
+            # one shot because winners are free rows, hence disjoint
+            # from the displaced (matched) rows
+            brows = np.nonzero(bidders)[0]
+            bcols = bc[brows]
+            best_inc = np.full(nc, -np.inf, np.float32)
+            np.maximum.at(best_inc, bcols, incr[brows].astype(np.float32))
+            tied = incr[brows] >= best_inc[bcols] - 1e-12
+            winner_row = np.full(nc, -1, np.int64)
+            np.maximum.at(winner_row, bcols[tied], brows[tied])
+            wc = np.nonzero(winner_row >= 0)[0]
+            if wc.size == 0:
                 return
+            wr = winner_row[wc]
+            olds = mcol[wc]
+            mrow[olds[olds >= 0]] = -1
+            mrow[wr] = wc
+            mcol[wc] = wr
+            price[wc] += best_inc[wc]
 
     e = max(eps, vmax / 4.0)
     while True:

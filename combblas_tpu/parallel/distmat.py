@@ -435,6 +435,38 @@ def from_rmat(add: Monoid, grid: ProcGrid, key, scale: int,
                            est_total=int(sym_m * 0.98))
 
 
+def with_capacity(a: DistSpMat, new_cap: int) -> DistSpMat:
+    """Re-pad every tile to ``new_cap`` (sentinel rows/cols, zero
+    vals). Shrinking requires all live entries to fit (checked).
+    Iterative algorithms (MCL) pin their matrix capacity with this so
+    every iteration reuses ONE compiled pipeline — per-iteration
+    capacity buckets otherwise recompile ~10 programs per step, which
+    on a 1-core host with remote compile dwarfs the device work."""
+    if new_cap == a.cap:
+        return a
+    if new_cap < a.cap:
+        mx = int(np.asarray(a.nnz).max())
+        if mx > new_cap:
+            raise ValueError(f"with_capacity({new_cap}) would drop "
+                             f"entries: a tile holds {mx}")
+        return DistSpMat(a.rows[:, :, :new_cap], a.cols[:, :, :new_cap],
+                         a.vals[:, :, :new_cap], a.nnz, a.grid,
+                         a.nrows, a.ncols, a.tile_m, a.tile_n)
+    extra = new_cap - a.cap
+    pr, pc = a.grid.pr, a.grid.pc
+    shard3 = a.grid.sharding(ROW_AXIS, COL_AXIS, None)
+    rows = jnp.concatenate(
+        [a.rows, jnp.full((pr, pc, extra), a.tile_m, jnp.int32)], axis=-1)
+    cols = jnp.concatenate(
+        [a.cols, jnp.full((pr, pc, extra), a.tile_n, jnp.int32)], axis=-1)
+    vals = jnp.concatenate(
+        [a.vals, jnp.zeros((pr, pc, extra), a.vals.dtype)], axis=-1)
+    return DistSpMat(
+        jax.device_put(rows, shard3), jax.device_put(cols, shard3),
+        jax.device_put(vals, shard3), a.nnz, a.grid,
+        a.nrows, a.ncols, a.tile_m, a.tile_n)
+
+
 def from_dense(add: Monoid, grid: ProcGrid, dense, zero,
                cap: Optional[int] = None) -> DistSpMat:
     """Test/golden-model constructor from a global dense array."""
