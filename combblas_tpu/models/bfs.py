@@ -108,6 +108,10 @@ class BfsPlan:
     # flag (advisor round-3: symmetry was docstring-only before)
     symmetric: bool = dataclasses.field(default=False,
                                         metadata=dict(static=True))
+    # whether route_masks are stored 2:1-packed (route.compact_masks);
+    # npad is then shape[-1]*64, not *32
+    route_compact: bool = dataclasses.field(default=False,
+                                            metadata=dict(static=True))
 
     @property
     def chunk_len(self) -> int:
@@ -170,18 +174,19 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
         est += (cap * 4 + nstages * npad // 8) * pr * pc / 5e6
         if est > route_budget_s or rt._load() is None:
             return plan
+    compact = npad >= rt._COMPACT_MIN_NPAD
     c2r = np.asarray(plan.c2r)            # (pr, pc, cap)
     tiles = []
     for i in range(pr):
         for j in range(pc):
-            tiles.append(_cached_route_masks(c2r[i, j]))
+            tiles.append(_cached_route_masks(c2r[i, j], compact))
     masks = np.stack(tiles).reshape(pr, pc, *tiles[0].shape)
     # device_put straight from numpy: resharding an already-committed
     # array would stage the full mask tensor on one device first — an
     # HBM spike at exactly the scales routing is for
     masks = jax.device_put(
         masks, a.grid.sharding(ROW_AXIS, COL_AXIS, None, None))
-    npad_r = masks.shape[-1] * 32
+    npad_r = rt.mask_npad(masks.shape[-1], compact)
     sb, vb, rs = _bit_structure(a, npad_r)
     cb = _col_bit_structure(plan.ccols, a.nnz, a.grid, npad_r)
     sym = False
@@ -190,19 +195,26 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
             a.rows[0, 0], a.cols[0, 0], a.nnz[0, 0], a.tile_m)))
     return dataclasses.replace(plan, route_masks=masks, starts_bits=sb,
                                valid_bits=vb, rstarts=rs, cstart_bits=cb,
-                               symmetric=sym)
+                               symmetric=sym, route_compact=compact)
 
 
-def _cached_route_masks(c2r_tile: np.ndarray) -> np.ndarray:
+def _cached_route_masks(c2r_tile: np.ndarray,
+                        compact: bool = False) -> np.ndarray:
     """plan_route_masks with a host disk cache keyed by the
     permutation's content hash: Beneš planning is minutes of one-core
     work at bench scales, and repeated runs on the same generated
     graph (fixed seed) rebuild the identical permutation.
-    COMBBLAS_TPU_ROUTE_CACHE overrides the location; empty disables."""
+    COMBBLAS_TPU_ROUTE_CACHE overrides the location; empty disables.
+    ``compact`` stores/loads the 2:1-packed form (route.compact_masks)
+    under a distinct cache name."""
     import hashlib
     import os
     import pathlib
     import tempfile
+
+    def _plan():
+        masks, _, npad = rt.plan_route_masks(c2r_tile)
+        return rt.compact_masks(masks, npad) if compact else masks
 
     # default to a user-owned location (XDG cache, else a uid-suffixed
     # tempdir created 0700): a world-writable shared default would let
@@ -219,26 +231,27 @@ def _cached_route_masks(c2r_tile: np.ndarray) -> np.ndarray:
             cdir = os.path.join(tempfile.gettempdir(),
                                 f"combblas_route_cache_{os.getuid()}")
     if not cdir:
-        return rt.plan_route_masks(c2r_tile)[0]
+        return _plan()
     key = hashlib.sha1(np.ascontiguousarray(c2r_tile).view(
         np.uint8)).hexdigest()[:20]
     root = pathlib.Path(cdir)
-    path = root / f"benes_{key}_{len(c2r_tile)}.npy"
+    suff = "_c1" if compact else ""
+    path = root / f"benes_{key}_{len(c2r_tile)}{suff}.npy"
     try:
         root.mkdir(parents=True, exist_ok=True, mode=0o700)
         if not explicit and os.stat(root).st_uid != os.getuid():
             # implicit default pre-created by another user: don't trust
             # it (an explicitly configured shared cache is the
             # operator's own call)
-            return rt.plan_route_masks(c2r_tile)[0]
+            return _plan()
     except Exception:
-        return rt.plan_route_masks(c2r_tile)[0]
+        return _plan()
     if path.exists():
         try:
             return np.load(path)
         except Exception:
             pass                       # corrupt cache entry: recompute
-    masks = rt.plan_route_masks(c2r_tile)[0]
+    masks = _plan()
     try:
         tmp = path.with_name(f"{path.stem}.{os.getpid()}.npy")
         np.save(tmp, masks)
@@ -402,7 +415,8 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
     # (~3x cheaper than the equivalent gather, but ~30x the traffic of
     # the bit route), then (3) max-scanned per row.
     use_route = plan.route_masks is not None
-    npad = plan.route_masks.shape[-1] * 32 if use_route else 0
+    npad = (rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+            if use_route else 0)
 
     def dense_step(act):
         def f(cols_t, starts_t, valid_t, ends_m, nonempty, cstarts, cdeg,
@@ -423,7 +437,8 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
                 S.MAX, seed_t, crun_t.reshape(chunk_len, 128))
             # (2) bits from col order to row order
             if use_route:
-                rp = rt.RoutePlan(rmasks[0, 0], cap, npad)
+                rp = rt.RoutePlan(rmasks[0, 0], cap, npad,
+                                  plan.route_compact)
                 words = rt.pack_bits(eact_c.T.reshape(-1)[:cap], npad)
                 eact_r = rt.unpack_bits(rt.apply_route_best(rp, words), cap)
             else:
@@ -636,9 +651,10 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
             f"{(a.grid.pr, a.grid.pc, a.cap, a.tile_m, a.tile_n)}: the "
             "plan was built for a different matrix")
     cap, tile_m = a.cap, a.tile_m
-    npad = plan.route_masks.shape[-1] * 32
+    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
     nwords = npad >> 5
-    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad)
+    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad,
+                      plan.route_compact)
     sb = plan.starts_bits[0, 0]
     vb = plan.valid_bits[0, 0]
     rstarts = plan.rstarts[0, 0]
@@ -760,7 +776,7 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     grid = a.grid
     pr, pc = grid.pr, grid.pc
     cap, tile_m, tile_n = a.cap, a.tile_m, a.tile_n
-    npad = plan.route_masks.shape[-1] * 32
+    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
     nwv = -(-tile_m // 32)               # vertex-bit words per block
     root = jnp.asarray(root, jnp.int32)
     capp = plan.cols_t.shape[-1]
@@ -777,7 +793,7 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
         ends_m, nonempty = ends_m[0, 0], nonempty[0, 0]
         cstarts, cdeg = cstarts[0, 0], cdeg[0, 0]
         sb, vb, cb, rstarts = sb[0, 0], vb[0, 0], cb[0, 0], rstarts[0, 0]
-        rp = rt.RoutePlan(rmasks[0, 0], cap, npad)
+        rp = rt.RoutePlan(rmasks[0, 0], cap, npad, plan.route_compact)
         row_nonempty = rstarts[1:] > rstarts[:-1]
         rs_lo = jnp.clip(rstarts[:-1], 0, npad - 1)   # (tile_m,)
 

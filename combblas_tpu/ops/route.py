@@ -110,11 +110,19 @@ def _benes_masks_py(perm: np.ndarray) -> np.ndarray:
 class RoutePlan:
     """Compiled Beneš masks for one fixed permutation of ``n`` slots
     (padded to ``npad`` = next power of two; the padding routes
-    identically).  ``masks``: (2*log2(npad)-1, npad/32) uint32."""
+    identically).  ``masks``: (2*log2(npad)-1, npad/32) uint32 — or,
+    when ``compact``, (2*log2(npad)-1, npad/64): a Beneš stage only
+    ever sets mask bits at pair-LOW slots ((slot & stride) == 0), so
+    the hi half of every stage's mask is structurally zero and the
+    masks pack 2:1 (`compact_masks`), halving both the plan's HBM
+    residency and the per-stage mask stream — the dominant route
+    traffic."""
 
     masks: jax.Array
     n: int = dataclasses.field(metadata=dict(static=True))
     npad: int = dataclasses.field(metadata=dict(static=True))
+    compact: bool = dataclasses.field(default=False,
+                                      metadata=dict(static=True))
 
     @property
     def nstages(self) -> int:
@@ -128,8 +136,13 @@ def plan_route(perm: np.ndarray) -> RoutePlan:
     the untimed Graph500 kernel-1 — ≅ OptimizeForGraph500,
     SpParMat.cpp:3285).  Cost O(n log n); the native router does
     ~2^27 slots in tens of seconds, the Python fallback is for small n.
+    Masks are stored compact (2:1) when the network is large enough
+    for the (R, 128) word layout.
     """
     masks, n, npad = plan_route_masks(perm)
+    if npad >= _COMPACT_MIN_NPAD:
+        return RoutePlan(jnp.asarray(compact_masks(masks, npad)), n,
+                         npad, compact=True)
     return RoutePlan(jnp.asarray(masks), n, npad)
 
 
@@ -174,6 +187,96 @@ def _stride(t: int, m: int, npad: int) -> int:
 
 
 # --------------------------------------------------------------------------
+# Mask compaction: every stage's mask bits live only at pair-LOW slots
+# ((slot & stride) == 0), so each stage packs 2:1. The packing pairs the
+# top/bottom HALVES of the word array elementwise — full word w pairs
+# with w + nwords/2 — with the bottom half's valid bits shifted onto the
+# top half's structurally-zero pair-high positions:
+#   stride 2^e, e<5 : bit-shift within the word (<< 2^e)
+#   5<=e<12 (lanes) : cyclic lane roll by 2^(e-5) within each 128-lane row
+#   e>=12 (rows)    : row shift by 2^(e-12) within each aligned pair group
+# All three shifts land valid bits exactly on the complementary pattern,
+# so pack = OR and unpack = (mask & pattern) / (unshift & pattern) —
+# two cheap VPU ops per stage in the kernels that stream them.
+# --------------------------------------------------------------------------
+
+_COMPACT_MIN_NPAD = 1 << 13   # below this the (R,128) row layout (and
+#                               the Pallas kernel) don't exist; full
+#                               masks are tiny there anyway
+
+
+def _patt_word(e: int) -> int:
+    """uint32 with bits at in-word pair-low positions ((bit & 2^e)==0)."""
+    p = 0
+    for i in range(32):
+        if not (i >> e) & 1:
+            p |= 1 << i
+    return p
+
+
+def compact_masks(masks: np.ndarray, npad: int) -> np.ndarray:
+    """(nstages, npad/32) full masks -> (nstages, npad/64) compact.
+    Host-side numpy, once per plan."""
+    m = npad.bit_length() - 1
+    nstages, nwords = masks.shape
+    assert nwords == npad >> 5 and nwords >= 256, (nwords, npad)
+    half = nwords >> 1
+    out = np.empty((nstages, half), np.uint32)
+    for t in range(nstages):
+        e = _stride(t, m, npad).bit_length() - 1
+        top, bot = masks[t, :half], masks[t, half:]
+        if e < 5:
+            out[t] = top | (bot << (1 << e))
+        elif e < 12:
+            dw = 1 << (e - 5)
+            b2 = bot.reshape(-1, 128)
+            out[t] = (top.reshape(-1, 128)
+                      | np.roll(b2, dw, axis=1)).reshape(-1)
+        else:
+            dr = 1 << (e - 12)
+            t2, b2 = top.reshape(-1, 128), bot.reshape(-1, 128)
+            if dr >= t2.shape[0]:     # outermost stage: bottom is empty
+                assert not bot.any()
+                out[t] = top
+            else:
+                out[t] = (t2 | np.roll(b2, dr, axis=0)).reshape(-1)
+    return out
+
+
+def _decompact_stage(c: jax.Array, e: int, npad: int) -> jax.Array:
+    """One stage's (npad/64,) compact mask -> (npad/32,) full mask
+    (XLA path; the Pallas kernel decompacts per strip instead)."""
+    if e < 5:
+        patt = jnp.uint32(_patt_word(e))
+        top, bot = c & patt, (c >> (1 << e)) & patt
+    elif e < 12:
+        dw = 1 << (e - 5)
+        c2 = c.reshape(-1, 128)
+        lane = jnp.arange(128, dtype=jnp.int32)
+        lp = jnp.where((lane & dw) == 0, jnp.uint32(0xFFFFFFFF),
+                       jnp.uint32(0))
+        top = (c2 & lp).reshape(-1)
+        bot = (jnp.roll(c2, -dw, axis=1) & lp).reshape(-1)
+    else:
+        dr = 1 << (e - 12)
+        c2 = c.reshape(-1, 128)
+        if dr >= c2.shape[0]:
+            top, bot = c, jnp.zeros_like(c)
+        else:
+            row = jnp.arange(c2.shape[0], dtype=jnp.int32)[:, None]
+            rp = jnp.where((row & dr) == 0, jnp.uint32(0xFFFFFFFF),
+                           jnp.uint32(0))
+            top = (c2 & rp).reshape(-1)
+            bot = (jnp.roll(c2, -dr, axis=0) & rp).reshape(-1)
+    return jnp.concatenate([top, bot])
+
+
+def mask_npad(mask_words: int, compact: bool) -> int:
+    """npad of a stored mask row of ``mask_words`` uint32 words."""
+    return mask_words * (64 if compact else 32)
+
+
+# --------------------------------------------------------------------------
 # Pallas application: the packed bit-vector stays resident in VMEM for
 # all 2*log2(npad)-1 stages; only the masks stream from HBM (one stage
 # per sequential grid step, double-buffered). HBM traffic drops from
@@ -210,13 +313,40 @@ _RBLR = 512    # strip rows for the route kernel: every stage either
 #               Mosaic compile time explodes with the sublane extent
 
 
-def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr):
+def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr,
+                  compact):
     import jax.experimental.pallas as pl
+    from combblas_tpu.ops.bitseg import _roll
 
     t = pl.program_id(0)
     r = wscr.shape[0]
     nstrips = r // blr
+    half = nstrips // 2
     k = jnp.abs(mexp - 1 - t)
+
+    def mask_strip(i, e):
+        """Full (blr, 128) mask for data strip ``i`` of stage-exponent
+        ``e`` — fetched directly, or decompacted from the 2:1 packed
+        top|shifted-bottom layout (see compact_masks)."""
+        if not compact:
+            return m_ref[0, pl.ds(i * blr, blr), :]
+        ci = jnp.where(i < half, i, i - half)
+        c = m_ref[0, pl.ds(ci * blr, blr), :]
+        top = i < half
+        if e < 5:
+            patt = jnp.uint32(_patt_word(e))
+            return jnp.where(top, c & patt, (c >> (1 << e)) & patt)
+        if e < 12:
+            dw = 1 << (e - 5)
+            lane = lax.broadcasted_iota(jnp.int32, (blr, 128), 1)
+            sel = jnp.where(top, c, _roll(c, -dw, 1))
+            return jnp.where((lane & dw) == 0, sel, jnp.uint32(0))
+        # in-strip row stage: 2*dr <= blr, so the local row index has
+        # the same dr-bit as the global one (strips are 2dr-aligned)
+        dr = 1 << (e - 12)
+        row = lax.broadcasted_iota(jnp.int32, (blr, 128), 0)
+        sel = jnp.where(top, c, _roll(c, -dr, 0))
+        return jnp.where((row & dr) == 0, sel, jnp.uint32(0))
 
     @pl.when(t == 0)
     def _init():
@@ -238,7 +368,7 @@ def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr):
                 def body(i, _):
                     rows = pl.ds(i * blr, blr)
                     a = wscr[rows, :]
-                    mk = m_ref[0, rows, :]
+                    mk = mask_strip(i, e)
                     wscr[rows, :] = _stage_swap(e, a, mk)
                     return 0
 
@@ -254,7 +384,14 @@ def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr):
                     rhi = pl.ds((lo + step) * blr, blr)
                     a = wscr[rlo, :]
                     b = wscr[rhi, :]
-                    mk = m_ref[0, rlo, :]
+                    if compact:
+                        # a pair-lo strip is all-valid rows; its mask
+                        # sits at compact strip `lo` (top half) or
+                        # `lo - half + step` (bottom: B[j] = C[j+dr])
+                        cs = jnp.where(lo < half, lo, lo - half + step)
+                        mk = m_ref[0, pl.ds(cs * blr, blr), :]
+                    else:
+                        mk = m_ref[0, rlo, :]
                     delta = (a ^ b) & mk
                     wscr[rlo, :] = a ^ delta
                     wscr[rhi, :] = b ^ delta
@@ -276,7 +413,9 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
                        interpret: bool = False) -> jax.Array:
     """`apply_route` as a single Pallas kernel (TPU): W resident in
     VMEM across all stages, masks streamed. Needs ~5x nwords x 4B of
-    VMEM — fine through npad = 2^27 on v5e (128 MB VMEM)."""
+    VMEM with full masks (npad up to 2^27 on 128 MB parts), ~4x with
+    compact masks (npad up to 2^28); apply_route_best gates on the
+    device's actual VMEM."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -285,14 +424,17 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
     nwords = rp.npad >> 5
     r = max(nwords // 128, 1)
     w2 = words.reshape(r, 128)
-    m3 = rp.masks.reshape(nstages, r, 128)
+    mr = r // 2 if rp.compact else r   # mask rows per stage
+    m3 = rp.masks.reshape(nstages, mr, 128)
+    # compact decompaction selects strips by top/bottom half, so the
+    # strip grid must split the halves evenly: blr <= r/2
     kernel = functools.partial(_route_kernel, mexp=m, nstages=nstages,
-                               blr=min(_RBLR, r))
+                               blr=min(_RBLR, mr), compact=rp.compact)
     out = pl.pallas_call(
         kernel,
         grid=(nstages,),
         in_specs=[
-            pl.BlockSpec((1, r, 128), lambda t: (t, 0, 0),
+            pl.BlockSpec((1, mr, 128), lambda t: (t, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((r, 128), lambda t: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -353,7 +495,11 @@ def apply_route(rp: RoutePlan, words: jax.Array) -> jax.Array:
     m = rp.npad.bit_length() - 1
     for t in range(rp.nstages):
         s = _stride(t, m, rp.npad)
-        mt = rp.masks[t]
+        if rp.compact:
+            mt = _decompact_stage(rp.masks[t], s.bit_length() - 1,
+                                  rp.npad)
+        else:
+            mt = rp.masks[t]
         if s >= 32:
             d = s >> 5
             w2 = words.reshape(-1, 2, d)
@@ -373,10 +519,11 @@ def apply_route_best(rp: RoutePlan, words: jax.Array) -> jax.Array:
     stage loop. Both are bit-identical."""
     from combblas_tpu.ops import pallas_kernels as pk
     # VMEM budget: W in+out+scratch + double-buffered mask stream =
-    # 5 x npad/8 bytes, gated on the actual device generation's VMEM
-    # (2^27 slots on 128 MB v4/v5; v2/v3 cap lower instead of failing
-    # to compile — advisor round-3 finding)
-    npad_max = _device_vmem_bytes() // 5 * 8
+    # (4 with compact masks, else 5) x npad/8 bytes, gated on the
+    # actual device generation's VMEM (2^28 slots on 128 MB v4/v5;
+    # v2/v3 cap lower instead of failing to compile — advisor round-3
+    # finding)
+    npad_max = _device_vmem_bytes() // (4 if rp.compact else 5) * 8
     if pk.enabled() and (1 << 13) <= rp.npad <= npad_max:
         return apply_route_pallas(rp, words)
     return apply_route(rp, words)

@@ -44,8 +44,9 @@ def main():
     print(f"# plan: {time.perf_counter()-t0:.1f}s", flush=True)
 
     cap = a.cap
-    npad = plan.route_masks.shape[-1] * 32
-    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad)
+    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad,
+                      plan.route_compact)
     sb = plan.starts_bits[0, 0]
     vb = plan.valid_bits[0, 0]
     rstarts = plan.rstarts[0, 0]

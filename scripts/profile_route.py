@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Compact-vs-full Beneš route timing on the real chip.
+
+Timing methodology for the axon relay: `block_until_ready` does NOT
+synchronize (it returns once the handle exists) and a scalar readback
+costs a ~100ms tunnel round trip, so each variant is timed as the
+SLOPE between K=4 and K=20 in-jit applications — RTT and dispatch
+overhead cancel.
+
+Usage: python scripts/profile_route.py [log2_n] [--breakdown]
+  --breakdown adds a DMA-only kernel (mask streaming without the
+  swap network) to separate bandwidth from compute.
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.ops import route as rt
+
+
+def measure(label, apply_fn, words, reps=3):
+    outs = {}
+    for K in (4, 20):
+        @jax.jit
+        def f(w, K=K):
+            return lax.fori_loop(0, K, lambda i, w: apply_fn(w), w)
+        y = f(words)
+        _ = int(np.asarray(y.reshape(-1)[0]))          # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            y = f(words)
+            _ = int(np.asarray(y.reshape(-1)[0]))      # forces completion
+        outs[K] = (time.perf_counter() - t0) / reps
+    per = (outs[20] - outs[4]) / 16
+    print(f"{label}: {per*1e3:.2f} ms/apply "
+          f"(K4={outs[4]*1e3:.0f}ms K20={outs[20]*1e3:.0f}ms)", flush=True)
+
+
+def _dma_kernel(m_ref, w_ref, o_ref, wscr, *, nstages, blr):
+    """Streams every stage's mask and ORs it into scratch — the route
+    kernel's data movement without the swap network."""
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+    r = wscr.shape[0]
+    nstrips = r // blr
+
+    @pl.when(t == 0)
+    def _init():
+        def body(i, _):
+            rows = pl.ds(i * blr, blr)
+            wscr[rows, :] = w_ref[rows, :]
+            return 0
+        lax.fori_loop(0, nstrips, body, 0)
+
+    def body(i, _):
+        rows = pl.ds(i * blr, blr)
+        wscr[rows, :] = wscr[rows, :] | m_ref[0, rows, :]
+        return 0
+    lax.fori_loop(0, nstrips, body, 0)
+
+    @pl.when(t == nstages - 1)
+    def _flush():
+        def body(i, _):
+            rows = pl.ds(i * blr, blr)
+            o_ref[rows, :] = wscr[rows, :]
+            return 0
+        lax.fori_loop(0, nstrips, body, 0)
+
+
+def dma_only(masks, words, npad):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nstages = masks.shape[0]
+    r = (npad >> 5) // 128
+    mr = masks.shape[1] // 128
+    kernel = functools.partial(_dma_kernel, nstages=nstages,
+                               blr=min(rt._RBLR, mr))
+    return pl.pallas_call(
+        kernel,
+        grid=(nstages,),
+        in_specs=[
+            pl.BlockSpec((1, mr, 128), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, 128), lambda t: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, 128), lambda t: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, 128), jnp.uint32),
+        scratch_shapes=[pltpu.VMEM((r, 128), jnp.uint32)],
+        compiler_params=rt._vmem_params(),
+    )(masks.reshape(nstages, mr, 128), words.reshape(r, 128))
+
+
+def main():
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    lg = int(args[0]) if args else 25
+    breakdown = "--breakdown" in sys.argv
+    n = 1 << lg
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(n).astype(np.int32)
+    t0 = time.perf_counter()
+    full, _, npad = rt.plan_route_masks(perm)
+    print(f"# plan: {time.perf_counter()-t0:.1f}s npad=2^{lg}", flush=True)
+    comp = rt.compact_masks(full, npad)
+    rp_full = rt.RoutePlan(jax.device_put(jnp.asarray(full)), n, npad)
+    rp_comp = rt.RoutePlan(jax.device_put(jnp.asarray(comp)), n, npad,
+                           compact=True)
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = jax.device_put(rt.pack_bits(jnp.asarray(bits), npad))
+
+    o1 = jax.jit(lambda w: rt.apply_route_pallas(rp_full, w))(words)
+    o2 = jax.jit(lambda w: rt.apply_route_pallas(rp_comp, w))(words)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    print("# full/compact outputs identical", flush=True)
+
+    measure("route full   ", lambda w: rt.apply_route_pallas(rp_full, w),
+            words)
+    measure("route compact", lambda w: rt.apply_route_pallas(rp_comp, w),
+            words)
+    if breakdown:
+        measure("dma-only full   ",
+                lambda w: dma_only(rp_full.masks, w, npad).reshape(-1),
+                words)
+        measure("dma-only compact",
+                lambda w: dma_only(rp_comp.masks, w, npad).reshape(-1),
+                words)
+
+
+if __name__ == "__main__":
+    main()

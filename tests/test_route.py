@@ -88,6 +88,67 @@ def test_pallas_route_strip_pair_branch(rng):
     np.testing.assert_array_equal(got, ref)
 
 
+def test_compact_masks_roundtrip(rng):
+    """2:1 mask packing: decompacting every stage reproduces the full
+    masks exactly, and a compact RoutePlan routes identically to a
+    full one (XLA and Pallas-interpret paths)."""
+    import jax
+    n = 1 << 13                      # smallest compact-eligible size
+    perm = rng.permutation(n).astype(np.int32)
+    full, _, npad = R.plan_route_masks(perm)
+    comp = R.compact_masks(full, npad)
+    assert comp.shape == (full.shape[0], full.shape[1] // 2)
+    m = npad.bit_length() - 1
+    for t in range(full.shape[0]):
+        e = R._stride(t, m, npad).bit_length() - 1
+        got = np.asarray(R._decompact_stage(jnp.asarray(comp[t]), e, npad))
+        np.testing.assert_array_equal(got, full[t], err_msg=f"stage {t}")
+    rp_full = R.RoutePlan(jnp.asarray(full), n, npad, compact=False)
+    rp_comp = R.plan_route(perm)
+    assert rp_comp.compact
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = R.pack_bits(jnp.asarray(bits), npad)
+    ref = np.asarray(R.apply_route(rp_full, words))
+    np.testing.assert_array_equal(np.asarray(R.apply_route(rp_comp, words)),
+                                  ref)
+    np.testing.assert_array_equal(
+        np.asarray(R.apply_route_pallas(rp_comp, words, interpret=True)),
+        ref)
+
+
+def test_compact_strip_pair_bottom_half(rng, monkeypatch):
+    """The compact `_big` branch's bottom-half mask index
+    (cs = lo - half + step) — the production path at bench scale
+    (npad ~2^27) — forced at test size by shrinking the strip rows so
+    nstrips=4 and strip-pair stages visit lo >= half."""
+    import jax
+    monkeypatch.setattr(R, "_RBLR", 1)
+    n = 1 << 14
+    perm = rng.permutation(n).astype(np.int32)
+    rp = R.plan_route(perm)
+    assert rp.compact
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = R.pack_bits(jnp.asarray(bits), rp.npad)
+    ref = np.asarray(R.apply_route(rp, words))
+    got = np.asarray(R.apply_route_pallas(rp, words, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_pallas_full_masks_still_supported(rng):
+    """The non-compact kernel path (full masks at npad >= 2^13) stays
+    correct — it is the baseline scripts/profile_route.py compares
+    against, and hand-built RoutePlans may still use it."""
+    n = 1 << 14
+    perm = rng.permutation(n).astype(np.int32)
+    full, _, npad = R.plan_route_masks(perm)
+    rp = R.RoutePlan(jnp.asarray(full), n, npad, compact=False)
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = R.pack_bits(jnp.asarray(bits), npad)
+    ref = np.asarray(R.apply_route(rp, words))
+    got = np.asarray(R.apply_route_pallas(rp, words, interpret=True))
+    np.testing.assert_array_equal(got, ref)
+
+
 def test_rejects_non_permutation():
     bad = np.array([0, 0, 1, 2] + list(range(4, 64)), np.int32)
     with pytest.raises(ValueError):
