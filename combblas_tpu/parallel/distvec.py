@@ -27,6 +27,7 @@ from typing import Optional, Union
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -313,22 +314,28 @@ def invert(sv: DistSpVec, out_glen: Optional[int] = None,
 
 def uniq(sv: DistSpVec) -> DistSpVec:
     """Keep the first (lowest-index) occurrence of every distinct
-    active value (≅ Uniq, FullyDistSpVec.cpp:890)."""
-    vals = _flat(sv.dense)
-    act = _flat(DistVec(sv.active, sv.grid, sv.axis, sv.glen))
-    n = sv.glen
-    idx = jnp.arange(n, dtype=jnp.int32)
-    # sort by (inactive-last, value, index); first of each value run wins
-    key_act = (~act).astype(jnp.int32)
-    order = jnp.lexsort((idx, vals, key_act))
-    sv_vals = vals[order]
-    sv_act = act[order]
-    first = jnp.concatenate([jnp.ones((1,), bool),
-                             sv_vals[1:] != sv_vals[:-1]]) & sv_act
-    # route the keep flag back to original positions
-    keep = jnp.zeros((n,), bool).at[order].set(first)
+    active value (≅ Uniq, FullyDistSpVec.cpp:890 — sort + adjacent
+    compare + inverse exchange). Distributed form: `dist_sort` by
+    (dead-last, value) clusters each value's run with the
+    lowest-index occurrence first (the automatic gidx tiebreak);
+    run starts are found with one boundary shift; a second
+    `dist_sort` keyed by original index routes the keep flags home —
+    ascending global indices ARE the original block layout, so the
+    sort is the inverse exchange. O(block) per device throughout."""
+    dense = sv.dense
+    live = sv.active & dense.valid_mask()
+    dead = dataclasses.replace(dense, data=(~live).astype(jnp.uint8))
+    sdead, svals, sgi = dist_sort((dead, dense))
+    prev_dead = shift_prev(sdead, fill=jnp.uint8(1))
+    prev_vals = shift_prev(svals, fill=dense.data.dtype.type(0))
+    first = ((sdead.data == 0)
+             & ((prev_dead.data != sdead.data)
+                | (prev_vals.data != svals.data)))
+    keepv = dataclasses.replace(
+        dense, data=first.astype(jnp.uint8))
+    _, _, keep_home = dist_sort(sgi, keepv)
     return dataclasses.replace(
-        sv, active=_from_flat(sv, keep & act, False))
+        sv, active=(keep_home.data != 0) & sv.active)
 
 
 def select_candidates(key, v: DistVec, nand: int) -> np.ndarray:
@@ -361,14 +368,112 @@ def concatenate(vecs: list) -> DistVec:
     return DistVec(_from_flat(tpl, flat), v0.grid, v0.axis, glen)
 
 
+def dist_sort(keys, *payloads: DistVec) -> tuple:
+    """Global ascending sort of a distributed vector, with payloads.
+
+    ≅ MemoryEfficientPSort (SpParHelper.cpp:103): the reference sorts
+    distributed (key, value) pairs with a bitonic split + local sort.
+    TPU-native form: every block is locally sorted, then a bitonic
+    sorting network over the ``p`` blocks runs merge-split steps —
+    `ppermute` the whole block to the stage partner, 2-block
+    `lax.sort` merge, keep the low or high half. Per-device memory
+    stays O(block) and the network is log2(p)(log2(p)+1)/2 exchanges;
+    nothing ever materializes the full vector (the flat-lexsort
+    fallback covers non-power-of-two block counts only).
+
+    ``keys``: one DistVec or a tuple (major first). A global-position
+    tiebreak key is appended automatically, so the sort is
+    deterministic and equal-key payloads keep index order. Returns
+    (*keys', gidx', *payloads') — gidx' is the permutation: the
+    original global index now living at each slot. Pad slots sort by
+    whatever key values they carry; callers that need them last
+    include a validity key.
+    """
+    keys = tuple(keys) if isinstance(keys, (tuple, list)) else (keys,)
+    k0 = keys[0]
+    p = k0.nblocks
+    nk = len(keys) + 1
+    gidx = dataclasses.replace(k0, data=k0.global_index())
+    vecs = keys + (gidx,) + payloads
+    if p == 1 or (p & (p - 1)):
+        # single block, or non-power-of-two block count (no bitonic
+        # network): replicated flat sort
+        flats = [_flat(v) for v in vecs]
+        order = jnp.lexsort(tuple(reversed(flats[:nk])))
+        return tuple(dataclasses.replace(v, data=_from_flat(v, f[order]))
+                     for v, f in zip(vecs, flats))
+    name = ROW_AXIS if k0.axis == ROW_AXIS else COL_AXIS
+    logp = p.bit_length() - 1
+    pairs = [[(i, i ^ (1 << j)) for i in range(p)] for j in range(logp)]
+
+    def f(*blocks):
+        blocks = [b[0] for b in blocks]
+        b = blocks[0].shape[0]
+        me = lax.axis_index(name)
+        cur = lax.sort(tuple(blocks), num_keys=nk)
+        for k in range(1, logp + 1):
+            asc = ((me >> k) & 1) == 0
+            for j in range(k - 1, -1, -1):
+                partner = me ^ (1 << j)
+                other = tuple(lax.ppermute(x, name, pairs[j])
+                              for x in cur)
+                both = tuple(jnp.concatenate([a, o])
+                             for a, o in zip(cur, other))
+                merged = lax.sort(both, num_keys=nk)
+                keep_low = (me < partner) == asc
+                cur = tuple(jnp.where(keep_low, m[:b], m[b:])
+                            for m in merged)
+        return tuple(c[None] for c in cur)
+
+    spec = k0.spec()
+    out = jax.shard_map(f, mesh=k0.grid.mesh,
+                        in_specs=(spec,) * len(vecs),
+                        out_specs=(spec,) * len(vecs))(
+        *(v.data for v in vecs))
+    return tuple(dataclasses.replace(v, data=o)
+                 for v, o in zip(vecs, out))
+
+
+def shift_prev(v: DistVec, fill) -> DistVec:
+    """Global shift by one toward higher index: out[i] = v[i-1]
+    (out[0] = fill). Block-local shift plus one `ppermute` of the
+    block-boundary element."""
+    p = v.nblocks
+    if p == 1 or (p & (p - 1)):
+        flat = _flat(v)
+        shifted = jnp.concatenate(
+            [jnp.full((1,), fill, flat.dtype), flat[:-1]])
+        return dataclasses.replace(v, data=_from_flat(v, shifted, fill))
+    name = ROW_AXIS if v.axis == ROW_AXIS else COL_AXIS
+    ring = [(i, (i + 1) % p) for i in range(p)]
+
+    def f(d):
+        d = d[0]
+        me = lax.axis_index(name)
+        last = lax.ppermute(d[-1:], name, ring)
+        prev = jnp.where(me == 0, jnp.asarray(fill, d.dtype), last[0])
+        return jnp.concatenate([prev[None], d[:-1]])[None]
+
+    out = jax.shard_map(f, mesh=v.grid.mesh, in_specs=(v.spec(),),
+                        out_specs=v.spec())(v.data)
+    return dataclasses.replace(v, data=out)
+
+
 def sp_sort(sv: DistSpVec):
     """Ascending sort of the active values (≅ FullyDistSpVec::sort,
-    FullyDistSpVec.cpp:712). Returns (sorted_vals, perm_index) as
-    flat (glen,) arrays with the live prefix of length nnz: perm[k] is
-    the original global index of the k-th smallest value."""
-    vals = _flat(sv.dense)
-    act = _flat(DistVec(sv.active, sv.grid, sv.axis, sv.glen))
-    idx = jnp.arange(sv.glen, dtype=jnp.int32)
-    key_act = (~act).astype(jnp.int32)
-    order = jnp.lexsort((idx, vals, key_act))
-    return vals[order], idx[order]
+    FullyDistSpVec.cpp:712, which calls par::sampleSort). Runs the
+    distributed block-bitonic `dist_sort` — O(block) per device —
+    keyed (dead-last, value); the flat result materializes only at
+    this driver boundary. Returns (sorted_vals, perm_index) as flat
+    (glen,) arrays with the live prefix of length nnz: perm[k] is the
+    original global index of the k-th smallest value."""
+    dense = sv.dense
+    valid = dense.valid_mask()
+    # three-level major key: live 0 < inactive 1 < pad 2 — truncating
+    # the sorted stream to glen then drops exactly the pad slots, so
+    # perm stays a permutation of 0..glen-1 (old contract)
+    dead = dataclasses.replace(
+        dense, data=jnp.where(valid, (~sv.active).astype(jnp.uint8),
+                              jnp.uint8(2)))
+    _, svals, sgi = dist_sort((dead, dense))
+    return _flat(svals)[:sv.glen], _flat(sgi)[:sv.glen]

@@ -46,12 +46,15 @@ def measure(label, apply_fn, words, reps=3):
 
 def _dma_kernel(m_ref, w_ref, o_ref, wscr, *, nstages, blr):
     """Streams every stage's mask and ORs it into scratch — the route
-    kernel's data movement without the swap network."""
+    kernel's data movement without the swap network. Mask strips are
+    iterated over the MASK's rows (mr = r/2 for compact masks), not
+    the scratch rows."""
     import jax.experimental.pallas as pl
 
     t = pl.program_id(0)
     r = wscr.shape[0]
     nstrips = r // blr
+    mstrips = m_ref.shape[1] // blr
 
     @pl.when(t == 0)
     def _init():
@@ -65,7 +68,7 @@ def _dma_kernel(m_ref, w_ref, o_ref, wscr, *, nstages, blr):
         rows = pl.ds(i * blr, blr)
         wscr[rows, :] = wscr[rows, :] | m_ref[0, rows, :]
         return 0
-    lax.fori_loop(0, nstrips, body, 0)
+    lax.fori_loop(0, mstrips, body, 0)
 
     @pl.when(t == nstages - 1)
     def _flush():

@@ -134,10 +134,12 @@ def test_compact_strip_pair_bottom_half(rng, monkeypatch):
     np.testing.assert_array_equal(got, ref)
 
 
-def test_pallas_full_masks_still_supported(rng):
+def test_pallas_full_masks_still_supported(rng, monkeypatch):
     """The non-compact kernel path (full masks at npad >= 2^13) stays
     correct — it is the baseline scripts/profile_route.py compares
-    against, and hand-built RoutePlans may still use it."""
+    against, and hand-built RoutePlans may still use it. _RBLR shrunk
+    so the full-mask `_big` strip-pair branch also runs (nstrips=4)."""
+    monkeypatch.setattr(R, "_RBLR", 1)
     n = 1 << 14
     perm = rng.permutation(n).astype(np.int32)
     full, _, npad = R.plan_route_masks(perm)
