@@ -304,6 +304,12 @@ def main():
         "validated_roots": args.validate_roots,
         "min_gteps": round(s["min_teps"] / 1e9, 4),
         "harmonic_mean_gteps": round(s["harmonic_mean_teps"] / 1e9, 4),
+        "timing": "all roots dispatched up-front; per-root time = "
+                  "(last-stats-arrival - first-dispatch)/nroots, which "
+                  "includes ONE relay round trip (conservative) but not "
+                  "the ~100ms/root WAN latency a sync readback per root "
+                  "would add (the reference's MPI_Wtime has no such "
+                  "link); see models/bfs.py graph500_run",
         **({"requested_scale": requested_scale,
             "fallback_reason": str(last_err)[:300]}
            if args.scale != requested_scale else {}),

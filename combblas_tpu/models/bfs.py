@@ -1134,28 +1134,66 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
 
     # warm-up compile (not timed, like the reference's untimed iteration 0)
     _ = np.asarray(run_with_stats(a, plan, deg, jnp.int32(roots[0]))[1])
+
+    # Pipelined per-root timing. A tunneled TPU pays a ~85-120 ms relay
+    # round trip on every synchronous stats readback; timing
+    # dispatch->readback per root adds that constant WAN latency to
+    # every measurement (the reference's MPI_Wtime around each search
+    # has no such link, TopDownBFS.cpp:437). Instead ALL roots are
+    # dispatched up front with their 2-scalar stats put on the async
+    # copy-back stream at dispatch time, and ONE window is measured
+    # (see the note below the drain loop). Memory stays flat: parents
+    # buffers are dropped at dispatch except for the validated roots.
+    queue: list = []    # (root_idx, parents|None, stats)
+
+    def dispatch(ri, root):
+        p, vn = run_with_stats(a, plan, deg, jnp.int32(root))
+        try:
+            vn.copy_to_host_async()
+        except Exception:
+            pass                   # stream hint only; asarray still works
+        keep_p = p if ri < validate_roots else None
+        queue.append((ri, keep_p, vn))
+
+    vparents: dict = {}
+    t_start = time.perf_counter()   # chip is idle (warm-up synced)
     for ri, root in enumerate(roots):
-        # timed region ends at the scalar fetch: on remote backends
-        # block_until_ready can ack before execution finishes, so the
-        # honest timestamp is a value readback that depends on the
-        # whole traversal
-        t0 = time.perf_counter()
-        parents, vn = run_with_stats(a, plan, deg, jnp.int32(root))
-        vn = np.asarray(vn)
-        dt = time.perf_counter() - t0
-        visited, nedges = int(vn[0]), int(vn[1])
-        if ri < validate_roots:
-            if grid.pr == 1 and grid.pc == 1:
-                validate_bfs_on_device(a, plan, root, parents, deg)
-            else:
-                if er is None:
-                    er, ec = np.asarray(r), np.asarray(c)
-                validate_bfs(er, ec, n, int(root), parents.to_global())
+        dispatch(ri, root)
+    per_root: list = []
+    while queue:
+        ri, kp, vn = queue.pop(0)
+        vnv = np.asarray(vn)                    # waits for arrival
+        per_root.append((int(vnv[0]), int(vnv[1])))
+        if kp is not None:
+            vparents[ri] = kp
+    t_end = time.perf_counter()
+    # the [dispatch, last arrival] window covers the nroots sequential
+    # executions plus ONE relay round trip (uplink of the first
+    # dispatch + downlink of the last result) — ~1% conservative at
+    # bench scale, and immune to the relay's bursty result delivery
+    # (individual arrival deltas are NOT usable: results arrive in
+    # batches). Each root is assigned the uniform T/nroots; device
+    # searches are near-iid on R-MAT (every root reaches the same
+    # giant component).
+    dt = (t_end - t_start) / max(1, len(per_root))
+    for ri, (visited, nedges) in enumerate(per_root):
         stats.teps.append(nedges / dt)
         stats.times.append(dt)
         stats.visited.append(visited)
         if verbose:
-            print(f"root {int(root)}: {visited} visited, "
-                  f"{nedges} edges, {dt*1e3:.1f} ms, "
+            print(f"root {int(roots[ri])}: {visited} visited, "
+                  f"{nedges} edges, {dt*1e3:.1f} ms (uniform), "
                   f"{nedges/dt/1e6:.1f} MTEPS", flush=True)
+
+    # validation (untimed, after the timed stream — kernel-2
+    # verification is outside the clock either way)
+    for ri in range(min(validate_roots, len(roots))):
+        root = roots[ri]
+        parents = vparents.pop(ri)
+        if grid.pr == 1 and grid.pc == 1:
+            validate_bfs_on_device(a, plan, root, parents, deg)
+        else:
+            if er is None:
+                er, ec = np.asarray(r), np.asarray(c)
+            validate_bfs(er, ec, n, int(root), parents.to_global())
     return stats
