@@ -114,7 +114,12 @@ def bench_spgemm(args):
 
     return {"scale": args.spgemm_scale, "c_nnz": nnz, "seconds": dt,
             "nnz_per_sec_per_chip": nnz / dt / max(1, len(jax.devices())),
-            "phases": spgemm_phases, "spmsv_phases": spmsv_phases}
+            "phases": spgemm_phases, "spmsv_phases": spmsv_phases,
+            "phases_note": "phase attribution requires a device sync "
+                           "per phase; on a tunneled TPU each sync "
+                           "includes the ~100ms relay round trip, so "
+                           "phase means are upper bounds (ratios, not "
+                           "absolutes, are meaningful)"}
 
 
 def bench_mcl(args):

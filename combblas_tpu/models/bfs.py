@@ -688,26 +688,42 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     visited0 = new0
     pcand0 = jnp.zeros_like(new0)
 
+    # fused level path: 3 Pallas launches (route&vb, fwd fill, bwd
+    # fill + frontier update + nonempty flag) instead of ~11 kernels —
+    # launch overhead dominated the unfused level (1.37 ms XLA glue vs
+    # 0.44 ms route+fill, measured at scale 20)
+    from combblas_tpu.ops import pallas_kernels as pk
+    npad_max = rt._device_vmem_bytes() // (4 if rp.compact else 5) * 8
+    fused = (pk.enabled() and nwords % 128 == 0
+             and (1 << 13) <= npad <= npad_max)
+
     def cond(carry):
-        new, _, _, it = carry
+        _, _, _, flag, it = carry
         # the level cap is a device-side safety net: a BFS level count
         # can never exceed the vertex count, and a runaway loop on a
         # remote accelerator is undebuggable
-        return jnp.any(new != 0) & (it < jnp.int32(tile_m))
+        return (flag != 0) & (it < jnp.int32(tile_m))
 
     def body(carry):
-        new, visited, pcand, it = carry
+        new, visited, pcand, _, it = carry
         # route: row-filled frontier bits ARE the column-order
         # sequence (symmetry); masks deliver "my column is active"
         # bits in row order
+        if fused:
+            hit = rt.apply_route_pallas(rp, new, and_mask=vb)
+            new2, visited, pcand, flagw = bs.seg_or_fill_bfs_pallas(
+                hit, sb, vb, visited, pcand)
+            return new2, visited, pcand, flagw[0, 0], it + 1
         eact = rt.apply_route_best(rp, new)
         hit = eact & vb
         reached = bs.seg_or_fill_best(hit, sb)
         new2 = reached & ~visited & vb
-        return new2, visited | new2, pcand | (hit & new2), it + 1
+        flag = jnp.any(new2 != 0).astype(jnp.uint32)
+        return new2, visited | new2, pcand | (hit & new2), flag, it + 1
 
-    _, _, pcand, _ = lax.while_loop(
-        cond, body, (new0, visited0, pcand0, jnp.int32(0)))
+    flag0 = jnp.any(new0 != 0).astype(jnp.uint32)
+    _, _, pcand, _, _ = lax.while_loop(
+        cond, body, (new0, visited0, pcand0, flag0, jnp.int32(0)))
 
     # single parent-extraction pass: max column id over marked edges
     pc8 = rt.unpack_bits(pcand, cap)

@@ -297,6 +297,109 @@ def seg_or_fill_best(x: jax.Array, starts: jax.Array) -> jax.Array:
     return seg_or_fill_bits(x, starts)
 
 
+def _fill_bwd_bfs_kernel(y_ref, s_ref, vb_ref, vis_ref, pc_ref, hit_ref,
+                         n2_ref, vis2_ref, pc2_ref, flag_ref, carry_ref,
+                         *, nbits_blk):
+    """Backward fill pass fused with the BFS level tail: from the
+    forward-scanned hit bits, per block compute
+      filled  = segment-wide OR (backward pass of seg_or_fill)
+      new2    = filled & ~visited & vb
+      visited' = visited | new2;  pcand' = pcand | (hit & new2)
+      flag   |= any(new2)
+    — one kernel launch instead of the ~6 elementwise XLA kernels the
+    unfused level body dispatches (launch overhead dominated the
+    level: measured 1.37 ms of glue vs 0.44 ms of route+fill at
+    scale 20)."""
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+    y0 = y_ref[...]
+    s = s_ref[...]
+    y, m = _block_or_scan(y0, s, nbits_blk, up=False)
+
+    @pl.when(t == 0)
+    def _init():
+        carry_ref[...] = jnp.zeros_like(carry_ref)
+        flag_ref[...] = jnp.zeros_like(flag_ref)
+
+    filled = y | (m & carry_ref[0, 0])
+    first = (filled[0:1, 0:1] & ~s[0:1, 0:1]) & jnp.uint32(1)
+    carry_ref[...] = jnp.where(first > 0, jnp.uint32(0xFFFFFFFF),
+                               jnp.uint32(0))
+    new2 = filled & ~vis_ref[...] & vb_ref[...]
+    n2_ref[...] = new2
+    vis2_ref[...] = vis_ref[...] | new2
+    pc2_ref[...] = pc_ref[...] | (hit_ref[...] & new2)
+    anyb = jnp.any(new2 != 0)      # bool reduce (Mosaic rejects
+    #                                unsigned-int reductions)
+    flag_ref[...] = flag_ref[...] | jnp.where(anyb, jnp.uint32(1),
+                                              jnp.uint32(0))
+
+
+def seg_or_fill_bfs_pallas(hit: jax.Array, starts: jax.Array,
+                           vb: jax.Array, visited: jax.Array,
+                           pcand: jax.Array, interpret: bool = False):
+    """The edge-space BFS level tail as two Pallas launches: the
+    standard forward fill pass, then `_fill_bwd_bfs_kernel`. Returns
+    (new2, visited', pcand', flag) with flag a uint32 scalar-shaped
+    (1,1) array, nonzero iff the new frontier is nonempty (replaces
+    the cond's full-array jnp.any pass)."""
+    import functools
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from combblas_tpu.ops.route import _sds
+
+    nwords = int(hit.shape[0])
+    r = nwords // 128
+    blr = min(_BLR, r)
+    nblk = -(-r // blr)
+    padr = nblk * blr
+    arrs = [hit.reshape(r, 128), starts.reshape(r, 128),
+            vb.reshape(r, 128), visited.reshape(r, 128),
+            pcand.reshape(r, 128)]
+    if padr != r:
+        pads = [0, 0xFFFFFFFF, 0, 0, 0]   # starts pad self-segments
+        arrs = [jnp.pad(a, ((0, padr - r), (0, 0)),
+                        constant_values=jnp.uint32(p))
+                for a, p in zip(arrs, pads)]
+    h2, s2, vb2, vis2, pc2 = arrs
+    nbits_blk = blr * 128 * 32
+
+    fwd = pl.pallas_call(
+        functools.partial(_fill_fwd_kernel, nbits_blk=nbits_blk),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((padr, 128), jnp.uint32, hit),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(h2, s2)
+
+    rev = pl.BlockSpec((blr, 128), lambda t, n=nblk: (n - 1 - t, 0),
+                       memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_fill_bwd_bfs_kernel, nbits_blk=nbits_blk),
+        grid=(nblk,),
+        in_specs=[rev] * 6,
+        out_specs=(rev, rev, rev,
+                   pl.BlockSpec((1, 1), lambda t: (0, 0),
+                                memory_space=pltpu.VMEM)),
+        out_shape=(_sds((padr, 128), jnp.uint32, hit),
+                   _sds((padr, 128), jnp.uint32, hit),
+                   _sds((padr, 128), jnp.uint32, hit),
+                   _sds((1, 1), jnp.uint32, hit)),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(fwd, s2, vb2, vis2, pc2, h2)
+    new2, visited2, pcand2, flag = out
+    return (new2[:r].reshape(-1), visited2[:r].reshape(-1),
+            pcand2[:r].reshape(-1), flag)
+
+
 def row_end_bits(y: jax.Array, starts: jax.Array, nbits: int) -> jax.Array:
     """Bits of ``y`` at segment END slots (slot before the next start,
     or the final valid slot), other slots zeroed. ``nbits`` = number

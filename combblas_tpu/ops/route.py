@@ -313,10 +313,16 @@ _RBLR = 512    # strip rows for the route kernel: every stage either
 #               Mosaic compile time explodes with the sublane extent
 
 
-def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr,
-                  compact):
+def _route_kernel(m_ref, w_ref, *rest, mexp, nstages, blr, compact):
     import jax.experimental.pallas as pl
     from combblas_tpu.ops.bitseg import _roll
+
+    # optional AND-mask input (fused `route(w) & v` — saves a separate
+    # elementwise kernel launch per BFS level): (m, w, v?, o, wscr)
+    if len(rest) == 3:
+        v_ref, o_ref, wscr = rest
+    else:
+        v_ref, (o_ref, wscr) = None, rest
 
     t = pl.program_id(0)
     r = wscr.shape[0]
@@ -403,19 +409,25 @@ def _route_kernel(m_ref, w_ref, o_ref, wscr, *, mexp, nstages, blr,
     def _flush():
         def body(i, _):
             rows = pl.ds(i * blr, blr)
-            o_ref[rows, :] = wscr[rows, :]
+            if v_ref is None:
+                o_ref[rows, :] = wscr[rows, :]
+            else:
+                o_ref[rows, :] = wscr[rows, :] & v_ref[rows, :]
             return 0
 
         lax.fori_loop(0, nstrips, body, 0)
 
 
 def apply_route_pallas(rp: RoutePlan, words: jax.Array,
-                       interpret: bool = False) -> jax.Array:
+                       interpret: bool = False,
+                       and_mask: jax.Array | None = None) -> jax.Array:
     """`apply_route` as a single Pallas kernel (TPU): W resident in
     VMEM across all stages, masks streamed. Needs ~5x nwords x 4B of
     VMEM with full masks (npad up to 2^27 on 128 MB parts), ~4x with
     compact masks (npad up to 2^28); apply_route_best gates on the
-    device's actual VMEM."""
+    device's actual VMEM. ``and_mask`` (same shape as words) fuses a
+    final `routed & and_mask` into the flush — one fewer kernel
+    launch on the BFS level path."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -430,22 +442,28 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
     # strip grid must split the halves evenly: blr <= r/2
     kernel = functools.partial(_route_kernel, mexp=m, nstages=nstages,
                                blr=min(_RBLR, mr), compact=rp.compact)
+    in_specs = [
+        pl.BlockSpec((1, mr, 128), lambda t: (t, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((r, 128), lambda t: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    args = [m3, w2]
+    if and_mask is not None:
+        in_specs.append(pl.BlockSpec((r, 128), lambda t: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(and_mask.reshape(r, 128))
     out = pl.pallas_call(
         kernel,
         grid=(nstages,),
-        in_specs=[
-            pl.BlockSpec((1, mr, 128), lambda t: (t, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((r, 128), lambda t: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((r, 128), lambda t: (0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=_sds((r, 128), jnp.uint32, words),
         scratch_shapes=[pltpu.VMEM((r, 128), jnp.uint32)],
         compiler_params=_vmem_params(),
         interpret=interpret,
-    )(m3, w2)
+    )(*args)
     return out.reshape(-1)
 
 

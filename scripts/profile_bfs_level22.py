@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""In-level cost split of the edge-space bit BFS on the real chip:
+route vs seg_or_fill vs the XLA glue, slope-timed in-jit with varied
+args (the relay caches identical dispatches and block_until_ready
+does not sync — see .claude/skills/verify/SKILL.md).
+
+Usage: python scripts/profile_bfs_level22.py [scale]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from combblas_tpu.models import bfs as B
+from combblas_tpu.ops import bitseg as bs
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import route as rt
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+def slope(label, make_f, args_of, K1=2, K2=32, reps=4):
+    outs = {}
+    seed = [0]
+    for K in (K1, K2):
+        f = make_f(K)
+        y = f(*args_of(999))
+        _ = int(np.asarray(y.reshape(-1)[:1])[0])
+        ts = []
+        for _rep in range(reps):
+            seed[0] += 1
+            t0 = time.perf_counter()
+            y = f(*args_of(seed[0]))
+            _ = int(np.asarray(y.reshape(-1)[:1])[0])
+            ts.append(time.perf_counter() - t0)
+        outs[K] = min(ts)
+    per = (outs[K2] - outs[K1]) / (K2 - K1)
+    print(f"{label}: {per*1e3:.2f} ms/iter", flush=True)
+    return per
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    n = 1 << scale
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    r, c = generate.rmat_edges(jax.random.key(1), scale, 16)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, grid, r, c, jnp.ones_like(r, jnp.bool_),
+                           n, n, cap=int(0.98 * r.shape[0]))
+    del r, c
+    jax.block_until_ready(a.rows)
+    t0 = time.perf_counter()
+    plan = B.plan_bfs(a, route=True)
+    jax.block_until_ready(plan.crows)
+    print(f"# plan: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    cap = a.cap
+    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad,
+                      plan.route_compact)
+    sb = plan.starts_bits[0, 0]
+    vb = plan.valid_bits[0, 0]
+    nwords = npad >> 5
+    print(f"# npad=2^{npad.bit_length()-1} compact={rp.compact}",
+          flush=True)
+
+    base = jax.device_put(
+        jnp.asarray(np.random.default_rng(0).integers(
+            0, 2**32, nwords, dtype=np.uint32)))
+
+    def args_of(s):
+        return (base, jnp.uint32(s))
+
+    def make_route(K):
+        @jax.jit
+        def f(w, s):
+            w = w ^ s
+            def body(i, w):
+                return rt.apply_route_best(rp, w)
+            return lax.fori_loop(0, K, body, w)
+        return f
+
+    def make_fill(K):
+        @jax.jit
+        def f(w, s):
+            w = w ^ s
+            def body(i, w):
+                return bs.seg_or_fill_best(w, sb)
+            return lax.fori_loop(0, K, body, w)
+        return f
+
+    def make_level(K):
+        @jax.jit
+        def f(w, s):
+            new = w ^ s
+            visited = new
+            pcand = jnp.zeros_like(new)
+            def body(i, carry):
+                new, visited, pcand = carry
+                eact = rt.apply_route_best(rp, new)
+                hit = eact & vb
+                reached = bs.seg_or_fill_best(hit, sb)
+                new2 = reached & ~visited & vb
+                return new2, visited | new2, pcand | (hit & new2)
+            new, _, _ = lax.fori_loop(0, K, body, (new, visited, pcand))
+            return new
+        return f
+
+    t_route = slope("route        ", make_route, args_of)
+    t_fill = slope("seg_or_fill  ", make_fill, args_of)
+    t_level = slope("full level   ", make_level, args_of)
+    print(f"# glue = {1e3*(t_level - t_route - t_fill):.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

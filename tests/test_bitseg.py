@@ -112,3 +112,52 @@ def test_seg_or_scan_matches_numpy(rng, n, p):
                                             _pack(sp, npad)),
                         _pack(sp, npad), n), npad))
     np.testing.assert_array_equal(gote.astype(bool), expect_ends)
+
+
+def test_fill_bfs_fused_tail_matches_composition(rng):
+    """The fused BFS level tail (seg_or_fill_bfs_pallas: backward fill
+    + frontier update + parent-candidate accumulate + nonempty flag)
+    is bit-identical to the unfused op composition it replaces."""
+    npad = 128 * 128 * 32            # one block, beyond-lane strides
+    n = npad
+    starts = np.zeros(n, bool)
+    starts[0] = True
+    starts[np.sort(rng.choice(n, 200, replace=False))] = True
+    hit = rng.random(n) < 0.01
+    vb = rng.random(n) < 0.9
+    visited = rng.random(n) < 0.3
+    pcand = rng.random(n) < 0.05
+    hw, sw = _pack(hit, npad), _pack(starts, npad)
+    vbw, visw, pcw = (_pack(vb, npad), _pack(visited, npad),
+                      _pack(pcand, npad))
+    # unfused model
+    reached = BS.seg_or_fill_bits(hw, sw)
+    new2_e = reached & ~visw & vbw
+    vis_e = visw | new2_e
+    pc_e = pcw | (hw & new2_e)
+    new2, vis2, pc2, flag = BS.seg_or_fill_bfs_pallas(
+        hw, sw, vbw, visw, pcw, interpret=True)
+    np.testing.assert_array_equal(np.asarray(new2), np.asarray(new2_e))
+    np.testing.assert_array_equal(np.asarray(vis2), np.asarray(vis_e))
+    np.testing.assert_array_equal(np.asarray(pc2), np.asarray(pc_e))
+    assert (int(np.asarray(flag)[0, 0]) != 0) == bool(
+        np.asarray(new2_e).any())
+    # empty-frontier flag
+    z = jnp.zeros_like(hw)
+    _, _, _, flag0 = BS.seg_or_fill_bfs_pallas(z, sw, vbw, visw, pcw,
+                                               interpret=True)
+    assert int(np.asarray(flag0)[0, 0]) == 0
+
+
+def test_route_and_mask_fusion(rng):
+    """apply_route_pallas(and_mask=...) equals route-then-AND."""
+    n = 1 << 14
+    perm = rng.permutation(n).astype(np.int32)
+    rp = R.plan_route(perm)
+    bits = rng.integers(0, 2, n).astype(np.int8)
+    words = R.pack_bits(jnp.asarray(bits), rp.npad)
+    vb = _pack(rng.random(rp.npad) < 0.8, rp.npad)
+    ref = np.asarray(R.apply_route(rp, words) & vb)
+    got = np.asarray(R.apply_route_pallas(rp, words, interpret=True,
+                                          and_mask=vb))
+    np.testing.assert_array_equal(got, ref)
