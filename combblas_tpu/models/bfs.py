@@ -98,6 +98,13 @@ class BfsPlan:
     # mesh bit BFS's vertex->edge frontier expansion; valid_bits covers
     # both orders since padding sorts last either way)
     cstart_bits: jax.Array | None = None
+    # gather-free parent extraction (single-tile bfs_bits): column-id
+    # bitplanes (pr, pc, nbits, npad/32); start-compact route masks
+    # (slot rstarts[r] -> r; same storage convention as route_masks);
+    # packed nonempty-row bits (pr, pc, ceil(tile_m/32))
+    colbits: jax.Array | None = None
+    srt_masks: jax.Array | None = None
+    rnon_bits: jax.Array | None = None
     # consistency token: the source matrix's static signature. A plan is
     # valid ONLY for the exact matrix it was built from (same tiles, same
     # nnz, same entry order); `bfs` asserts the static part at trace time.
@@ -193,9 +200,51 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
     if pr == 1 and pc == 1 and a.tile_m == a.tile_n:
         sym = bool(np.asarray(_pattern_symmetric(
             a.rows[0, 0], a.cols[0, 0], a.nnz[0, 0], a.tile_m)))
-    return dataclasses.replace(plan, route_masks=masks, starts_bits=sb,
+    plan = dataclasses.replace(plan, route_masks=masks, starts_bits=sb,
                                valid_bits=vb, rstarts=rs, cstart_bits=cb,
                                symmetric=sym, route_compact=compact)
+    if pr == 1 and pc == 1:
+        plan = _plan_parent_extract(a, plan, npad_r, compact)
+    return plan
+
+
+def _plan_parent_extract(a: dm.DistSpMat, plan: BfsPlan, npad: int,
+                         compact: bool) -> BfsPlan:
+    """Single-tile gather-free parent extraction structures:
+    column-id bitplanes of the row-sorted edge order, the
+    start-compact Beneš route (slot rstarts[r] -> r), and the
+    packed nonempty-row mask. Per-row gathers measured ~73 ms for 4M
+    rows at scale 22 — these turn the extraction into streamed bit
+    kernels + one more static route."""
+    tile_m = a.tile_m
+    if tile_m > npad:
+        # the start-compact permutation maps slot rstarts[r] -> r and
+        # needs every row index to be a valid slot; a matrix with more
+        # rows than padded edge slots keeps the gather extraction
+        return plan
+    nbits = max(1, (a.tile_n - 1).bit_length())
+    cols = a.cols[0, 0]
+    colbits = jnp.stack([
+        rt.pack_bits(((cols >> b) & 1).astype(jnp.int8), npad)
+        for b in range(nbits)])
+    rstarts = np.asarray(plan.rstarts[0, 0])
+    nonempty = rstarts[1:] > rstarts[:-1]
+    rows_ne = np.nonzero(nonempty)[0].astype(np.int64)
+    src = rstarts[:-1][nonempty].astype(np.int64)
+    perm = np.full(npad, -1, np.int64)
+    perm[src] = rows_ne
+    free_dst = np.setdiff1d(np.arange(npad, dtype=np.int64), rows_ne,
+                            assume_unique=False)
+    perm[perm < 0] = free_dst[:int((perm < 0).sum())]
+    srt = _cached_route_masks(perm.astype(np.int32), compact)
+    nwm = -(-tile_m // 32)
+    rnon = np.asarray(rt.pack_bits(jnp.asarray(nonempty.astype(np.int8)),
+                                   nwm * 32))
+    return dataclasses.replace(
+        plan,
+        colbits=jax.device_put(colbits)[None, None],
+        srt_masks=jax.device_put(jnp.asarray(srt))[None, None],
+        rnon_bits=jax.device_put(jnp.asarray(rnon))[None, None])
 
 
 def _cached_route_masks(c2r_tile: np.ndarray,
@@ -437,7 +486,7 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
                 S.MAX, seed_t, crun_t.reshape(chunk_len, 128))
             # (2) bits from col order to row order
             if use_route:
-                rp = rt.RoutePlan(rmasks[0, 0], cap, npad,
+                rp = rt.RoutePlan(rt.tile_masks(rmasks[0, 0]), cap, npad,
                                   plan.route_compact)
                 words = rt.pack_bits(eact_c.T.reshape(-1)[:cap], npad)
                 eact_r = rt.unpack_bits(rt.apply_route_best(rp, words), cap)
@@ -653,7 +702,7 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     cap, tile_m = a.cap, a.tile_m
     npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
     nwords = npad >> 5
-    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad,
+    rp = rt.RoutePlan(rt.tile_masks(plan.route_masks[0, 0]), cap, npad,
                       plan.route_compact)
     sb = plan.starts_bits[0, 0]
     vb = plan.valid_bits[0, 0]
@@ -691,11 +740,9 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     # fused level path: 3 Pallas launches (route&vb, fwd fill, bwd
     # fill + frontier update + nonempty flag) instead of ~11 kernels —
     # launch overhead dominated the unfused level (1.37 ms XLA glue vs
-    # 0.44 ms route+fill, measured at scale 20)
-    from combblas_tpu.ops import pallas_kernels as pk
-    npad_max = rt._device_vmem_bytes() // (4 if rp.compact else 5) * 8
-    fused = (pk.enabled() and nwords % 128 == 0
-             and (1 << 13) <= npad <= npad_max)
+    # 0.44 ms route+fill, measured at scale 20). extra_arrays=1: the
+    # and_mask input is one more full-size VMEM resident.
+    fused = nwords % 128 == 0 and rt.route_pallas_ok(rp, extra_arrays=1)
 
     def cond(carry):
         _, _, _, flag, it = carry
@@ -725,16 +772,45 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     _, _, pcand, _, _ = lax.while_loop(
         cond, body, (new0, visited0, pcand0, flag0, jnp.int32(0)))
 
-    # single parent-extraction pass: max column id over marked edges
-    pc8 = rt.unpack_bits(pcand, cap)
-    chunk_len = plan.cols_t.shape[-1] // 128
-    eb = tl.to_chunked(pc8, fill=0).reshape(-1)
-    e_act = (eb > 0) & plan.valid_t[0, 0]
-    contrib = jnp.where(e_act, plan.cols_t[0, 0], _IDENT)
-    y = tl.seg_reduce_pre(S.MAX, contrib.reshape(chunk_len, 128),
-                          plan.starts_t[0, 0].reshape(chunk_len, 128),
-                          plan.ends_m[0, 0], plan.nonempty[0, 0])
-    parents = jnp.where(y != _IDENT, y, NO_PARENT)
+    # parent extraction: max column id over marked edges, per row.
+    # Gather-free fast path (see _plan_parent_extract): the tile is
+    # (row, col)-sorted, so the row's max candidate is its HIGHEST
+    # pcand bit; one reverse-streamed kernel isolates it and
+    # backward-fills the column-id bitplanes to every row's start
+    # slot; the start-compact Beneš route then lands start-slot bits
+    # at row positions, and the parent ids assemble from bitplanes
+    # with dense word ops. Replaces an unpack + chunk-transpose +
+    # segmented scan + 4M-row gather pipeline measured at 96 ms/root
+    # (of a 118 ms traversal) at scale 22.
+    if fused and plan.colbits is not None:
+        planes = bs.parent_planes_pallas(pcand, sb,
+                                         plan.colbits[0, 0])
+        srt = rt.RoutePlan(rt.tile_masks(plan.srt_masks[0, 0]), cap,
+                           npad, plan.route_compact)
+        nwm = plan.rnon_bits.shape[-1]
+        nbits = planes.shape[0] - 1
+        # one scan over the planes keeps a SINGLE route-kernel
+        # instance in the executable (unrolled/vmapped variants
+        # crashed the TPU compiler at bench scale)
+        routed = lax.map(lambda w: rt.apply_route_pallas(srt, w)[:nwm],
+                         planes)
+        hasc = routed[nbits] & plan.rnon_bits[0, 0]
+        parents = jnp.zeros((tile_m,), jnp.int32)
+        for b in range(nbits):
+            pb = rt.unpack_bits(routed[b] & hasc, tile_m)
+            parents = parents | (pb.astype(jnp.int32) << b)
+        hc8 = rt.unpack_bits(hasc, tile_m)
+        parents = jnp.where(hc8 > 0, parents, NO_PARENT)
+    else:
+        pc8 = rt.unpack_bits(pcand, cap)
+        chunk_len = plan.cols_t.shape[-1] // 128
+        eb = tl.to_chunked(pc8, fill=0).reshape(-1)
+        e_act = (eb > 0) & plan.valid_t[0, 0]
+        contrib = jnp.where(e_act, plan.cols_t[0, 0], _IDENT)
+        y = tl.seg_reduce_pre(S.MAX, contrib.reshape(chunk_len, 128),
+                              plan.starts_t[0, 0].reshape(chunk_len, 128),
+                              plan.ends_m[0, 0], plan.nonempty[0, 0])
+        parents = jnp.where(y != _IDENT, y, NO_PARENT)
     parents = parents.at[root].set(root)
     return dv.DistVec(parents[None, :], a.grid, ROW_AXIS, a.nrows)
 
@@ -809,7 +885,8 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
         ends_m, nonempty = ends_m[0, 0], nonempty[0, 0]
         cstarts, cdeg = cstarts[0, 0], cdeg[0, 0]
         sb, vb, cb, rstarts = sb[0, 0], vb[0, 0], cb[0, 0], rstarts[0, 0]
-        rp = rt.RoutePlan(rmasks[0, 0], cap, npad, plan.route_compact)
+        rp = rt.RoutePlan(rt.tile_masks(rmasks[0, 0]), cap, npad,
+                          plan.route_compact)
         row_nonempty = rstarts[1:] > rstarts[:-1]
         rs_lo = jnp.clip(rstarts[:-1], 0, npad - 1)   # (tile_m,)
 

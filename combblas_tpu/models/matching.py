@@ -165,34 +165,50 @@ def maximum_matching(a: dm.DistSpMat, init: str = "greedy"):
             frontier[mcol[new]] = True
         if len(free_cols) == 0:
             break
-        # flip vertex-disjoint augmenting paths
-        used_rows = np.zeros(nr, bool)
-        augmented = False
-        for t in free_cols:
-            path = []
-            c = t
-            ok = True
-            while True:
-                r = col_parent[c]
-                if r < 0 or used_rows[r]:
-                    ok = False
-                    break
-                path.append((r, c))
-                nxt = mrow[r]
-                if nxt < 0:
-                    break
-                c = nxt
-            if not ok:
-                continue
-            for r, c in path:
-                used_rows[r] = True
-            for r, c in path:
-                mrow[r] = c
-                mcol[c] = r
-            augmented = True
-        if not augmented:
+        if not _flip_augmenting_paths(np.asarray(free_cols, np.int64),
+                                      col_parent, mrow, mcol):
             break
     return mrow, mcol
+
+
+def _flip_augmenting_paths(free_cols, col_parent, mrow, mcol) -> bool:
+    """Flip a vertex-disjoint set of augmenting paths, one lockstep
+    numpy walk for ALL candidate end columns at once (the Python
+    per-path pointer chase this replaces was O(paths x length); the
+    depth loop here is bounded by the BFS wave count). Disjointness:
+    every row votes for the lowest path id touching it; a path flips
+    iff it won every one of its rows (any vertex-disjoint subset
+    keeps the algorithm correct — the outer phase loop re-searches).
+    Mutates mrow/mcol; returns whether any path flipped."""
+    k = len(free_cols)
+    nr = len(mrow)
+    c = free_cols.astype(np.int64).copy()
+    act = np.ones(k, bool)
+    rows_steps, cols_steps = [], []
+    while act.any():
+        r = np.where(act, col_parent[c], -1)
+        act = act & (r >= 0)
+        rows_steps.append(np.where(act, r, -1))
+        cols_steps.append(np.where(act, c, -1))
+        nxt = np.where(act, mrow[np.clip(r, 0, None)], -1)
+        act = act & (nxt >= 0)        # path complete at a free row
+        c = np.where(act, nxt, c)
+    if not rows_steps:
+        return False
+    rows = np.stack(rows_steps)       # (depth, k)
+    cols = np.stack(cols_steps)
+    pid = np.broadcast_to(np.arange(k), rows.shape)
+    live = rows >= 0
+    winner = np.full(nr, k, np.int64)
+    np.minimum.at(winner, rows[live], pid[live])
+    won = np.ones(k, bool)
+    np.logical_and.at(won, pid[live], winner[rows[live]] == pid[live])
+    flip = live & won[pid]
+    if not flip.any():
+        return False
+    mrow[rows[flip]] = cols[flip]
+    mcol[cols[flip]] = rows[flip]
+    return True
 
 
 def matching_cardinality(mrow) -> int:

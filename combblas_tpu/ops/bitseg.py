@@ -230,6 +230,50 @@ def _fill_bwd_kernel(y_ref, s_ref, o_ref, carry_ref, *, nbits_blk):
                                jnp.uint32(0))
 
 
+def _fill_blocking(x: jax.Array, starts: jax.Array, *extras):
+    """Shared block-layout setup of the streamed fill passes:
+    (r, 128) views padded to whole blocks — pads are inert
+    (self-segmenting starts=all-ones, zero data). Returns
+    (blr, nblk, padr, r, nbits_blk, x2, s2, *extras2)."""
+    nwords = int(x.shape[0])
+    r = nwords // 128
+    blr = min(_BLR, r)
+    nblk = -(-r // blr)
+    padr = nblk * blr
+    arrs = [x.reshape(r, 128), starts.reshape(r, 128)] + [
+        e.reshape(r, 128) for e in extras]
+    if padr != r:
+        pads = [0, 0xFFFFFFFF] + [0] * len(extras)
+        arrs = [jnp.pad(a, ((0, padr - r), (0, 0)),
+                        constant_values=jnp.uint32(p))
+                for a, p in zip(arrs, pads)]
+    return (blr, nblk, padr, r, blr * 128 * 32, *arrs)
+
+
+def _fill_fwd_call(blr, nblk, padr, nbits_blk, x2, s2, like,
+                   interpret):
+    """The forward fill pass launch, shared by the plain and the
+    BFS-fused fills."""
+    import functools
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from combblas_tpu.ops.route import _sds
+
+    return pl.pallas_call(
+        functools.partial(_fill_fwd_kernel, nbits_blk=nbits_blk),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+                  pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((blr, 128), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((padr, 128), jnp.uint32, like),
+        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(x2, s2)
+
+
 def seg_or_fill_pallas(x: jax.Array, starts: jax.Array,
                        interpret: bool = False) -> jax.Array:
     """seg_or_fill_bits as two block-streamed Pallas passes: forward
@@ -242,33 +286,9 @@ def seg_or_fill_pallas(x: jax.Array, starts: jax.Array,
     from jax.experimental.pallas import tpu as pltpu
     from combblas_tpu.ops.route import _sds
 
-    nwords = int(x.shape[0])
-    r = nwords // 128
-    blr = min(_BLR, r)
-    nblk = -(-r // blr)
-    padr = nblk * blr
-    x2 = x.reshape(r, 128)
-    s2 = starts.reshape(r, 128)
-    if padr != r:
-        # pad with self-segmenting empty slots (start=1, x=0): inert
-        x2 = jnp.pad(x2, ((0, padr - r), (0, 0)))
-        s2 = jnp.pad(s2, ((0, padr - r), (0, 0)),
-                     constant_values=jnp.uint32(0xFFFFFFFF))
-    nbits_blk = blr * 128 * 32
-
-    fwd = pl.pallas_call(
-        functools.partial(_fill_fwd_kernel, nbits_blk=nbits_blk),
-        grid=(nblk,),
-        in_specs=[pl.BlockSpec((blr, 128), lambda t: (t, 0),
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((blr, 128), lambda t: (t, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((blr, 128), lambda t: (t, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=_sds((padr, 128), jnp.uint32, x),
-        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
-        interpret=interpret,
-    )(x2, s2)
+    blr, nblk, padr, r, nbits_blk, x2, s2 = _fill_blocking(x, starts)
+    fwd = _fill_fwd_call(blr, nblk, padr, nbits_blk, x2, s2, x,
+                         interpret)
 
     bwd = pl.pallas_call(
         functools.partial(_fill_bwd_kernel, nbits_blk=nbits_blk),
@@ -349,35 +369,11 @@ def seg_or_fill_bfs_pallas(hit: jax.Array, starts: jax.Array,
     from jax.experimental.pallas import tpu as pltpu
     from combblas_tpu.ops.route import _sds
 
-    nwords = int(hit.shape[0])
-    r = nwords // 128
-    blr = min(_BLR, r)
-    nblk = -(-r // blr)
-    padr = nblk * blr
-    arrs = [hit.reshape(r, 128), starts.reshape(r, 128),
-            vb.reshape(r, 128), visited.reshape(r, 128),
-            pcand.reshape(r, 128)]
-    if padr != r:
-        pads = [0, 0xFFFFFFFF, 0, 0, 0]   # starts pad self-segments
-        arrs = [jnp.pad(a, ((0, padr - r), (0, 0)),
-                        constant_values=jnp.uint32(p))
-                for a, p in zip(arrs, pads)]
-    h2, s2, vb2, vis2, pc2 = arrs
-    nbits_blk = blr * 128 * 32
-
-    fwd = pl.pallas_call(
-        functools.partial(_fill_fwd_kernel, nbits_blk=nbits_blk),
-        grid=(nblk,),
-        in_specs=[pl.BlockSpec((blr, 128), lambda t: (t, 0),
-                               memory_space=pltpu.VMEM),
-                  pl.BlockSpec((blr, 128), lambda t: (t, 0),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((blr, 128), lambda t: (t, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=_sds((padr, 128), jnp.uint32, hit),
-        scratch_shapes=[pltpu.VMEM((1, 1), jnp.uint32)],
-        interpret=interpret,
-    )(h2, s2)
+    (blr, nblk, padr, r, nbits_blk,
+     h2, s2, vb2, vis2, pc2) = _fill_blocking(hit, starts, vb,
+                                              visited, pcand)
+    fwd = _fill_fwd_call(blr, nblk, padr, nbits_blk, h2, s2, hit,
+                         interpret)
 
     rev = pl.BlockSpec((blr, 128), lambda t, n=nblk: (n - 1 - t, 0),
                        memory_space=pltpu.VMEM)
@@ -398,6 +394,126 @@ def seg_or_fill_bfs_pallas(hit: jax.Array, starts: jax.Array,
     new2, visited2, pcand2, flag = out
     return (new2[:r].reshape(-1), visited2[:r].reshape(-1),
             pcand2[:r].reshape(-1), flag)
+
+
+def _iso_bwd_kernel(pc_ref, s_ref, o_ref, carry_ref, *, nbits_blk):
+    """Reverse-streamed pass isolating each segment's HIGHEST set bit:
+    iso = x & ~(backward-EXCLUSIVE segment OR). carry: the open
+    segment's OR entering from the right."""
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+    x = pc_ref[...]
+    s = s_ref[...]
+
+    @pl.when(t == 0)
+    def _init():
+        carry_ref[0, 0] = jnp.uint32(0)
+
+    carry_in = carry_ref[0, 0]
+    y, m = _block_or_scan(x, s, nbits_blk, up=False)
+    y = y | (m & carry_in)
+    # exclusive = inclusive of the NEXT slot (segment-blocked). The
+    # block's very last slot has no in-block next: its cross-block
+    # "set bits strictly to the right" is carry_in under the
+    # open-segment admission mask.
+    blr = x.shape[0]
+    rowi = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    lanei = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    lastw = jnp.where((rowi == blr - 1) & (lanei == 127),
+                      jnp.uint32(0x80000000), jnp.uint32(0))
+    excl = (_down2(y, 1) & ~_down2(s, 1)) | (m & carry_in & lastw)
+    o_ref[...] = x & ~excl
+    first_open = (y[0, 0] & ~s[0, 0]) & jnp.uint32(1)
+    carry_ref[0, 0] = jnp.where(first_open > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+
+
+def _plane_fill_kernel(iso_ref, s_ref, cb_ref, o_ref, carry_ref, *,
+                       nbits_blk):
+    """Backward-inclusive segment OR of (iso & colbit_plane), one
+    (plane, block) grid cell at a time — at every segment START slot
+    the output bit equals the plane's bit of the segment's isolated
+    (maximum) column. Grid = (nplanes, nblk) with blocks reverse-
+    streamed within each plane; the carry resets per plane."""
+    import jax.experimental.pallas as pl
+
+    tb = pl.program_id(1)
+
+    @pl.when(tb == 0)
+    def _init():
+        carry_ref[0, 0] = jnp.uint32(0)
+
+    x = iso_ref[...] & cb_ref[0]
+    s = s_ref[...]
+    cin = carry_ref[0, 0]
+    y, m = _block_or_scan(x, s, nbits_blk, up=False)
+    y = y | (m & cin)
+    o_ref[0] = y
+    fo = (y[0, 0] & ~s[0, 0]) & jnp.uint32(1)
+    carry_ref[0, 0] = jnp.where(fo > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+
+
+def parent_planes_pallas(pcand: jax.Array, starts: jax.Array,
+                         colbits: jax.Array,
+                         interpret: bool = False) -> jax.Array:
+    """(nbits+1, nwords): backward-filled parent-column bitplanes.
+    ``colbits``: (nbits, nwords) static column-id bitplanes in flat
+    row-sorted edge order (bit at slot i of plane b = bit b of
+    cols[i]). Output plane b < nbits carries, at each row's start
+    slot, bit b of the row's maximum pcand-marked column; the last
+    plane carries "row has any candidate". All other slots are
+    row-constant fill (harmless — the start-compact route reads only
+    start slots). Two kernels (iso, then a (plane, block) grid) so
+    each body holds ONE scan network — a 23-plane unrolled body
+    crashed the TPU compiler. Gather-free by construction: the caller
+    routes start-slot bits to row positions with a precompiled Beneš
+    permutation instead of gathering per row (measured 73 ms for a
+    4M-row gather — routes are ~1 ms)."""
+    import functools
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from combblas_tpu.ops.route import _sds
+
+    nbits = int(colbits.shape[0])
+    nplanes = nbits + 1
+    blr, nblk, padr, r, nbits_blk, x2, s2 = _fill_blocking(pcand, starts)
+    cb = colbits.reshape(nbits, r, 128)
+    # plane nbits is "iso itself": append an all-ones plane
+    cb = jnp.concatenate(
+        [cb, jnp.full((1, r, 128), 0xFFFFFFFF, jnp.uint32)])
+    if padr != r:
+        cb = jnp.pad(cb, ((0, 0), (0, padr - r), (0, 0)))
+    rev = pl.BlockSpec((blr, 128), lambda t, n=nblk: (n - 1 - t, 0),
+                       memory_space=pltpu.VMEM)
+    iso = pl.pallas_call(
+        functools.partial(_iso_bwd_kernel, nbits_blk=nbits_blk),
+        grid=(nblk,),
+        in_specs=[rev, rev],
+        out_specs=rev,
+        out_shape=_sds((padr, 128), jnp.uint32, pcand),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(x2, s2)
+
+    rev2 = pl.BlockSpec((blr, 128), lambda p, t, n=nblk: (n - 1 - t, 0),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_plane_fill_kernel, nbits_blk=nbits_blk),
+        grid=(nplanes, nblk),
+        in_specs=[rev2, rev2,
+                  pl.BlockSpec((1, blr, 128),
+                               lambda p, t, n=nblk: (p, n - 1 - t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, blr, 128),
+                               lambda p, t, n=nblk: (p, n - 1 - t, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((nplanes, padr, 128), jnp.uint32, pcand),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(iso, s2, cb)
+    return out[:, :r].reshape(nplanes, -1)
 
 
 def row_end_bits(y: jax.Array, starts: jax.Array, nbits: int) -> jax.Array:

@@ -1,0 +1,34 @@
+"""Shared setup for the BFS profiling scripts: build a symmetric
+R-MAT matrix on one device, plan it with routing, and pull the
+single-tile bit-BFS ingredients out of the plan."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+from combblas_tpu.models import bfs as B
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import route as rt
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+def build(scale: int, edgefactor: int = 16, seed: int = 1):
+    """Returns (a, plan, rp, sb, vb, npad) for a 1x1 grid."""
+    n = 1 << scale
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    r, c = generate.rmat_edges(jax.random.key(seed), scale, edgefactor)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, grid, r, c, jnp.ones_like(r, jnp.bool_),
+                           n, n, cap=int(0.98 * r.shape[0]))
+    del r, c
+    jax.block_until_ready(a.rows)
+    t0 = time.perf_counter()
+    plan = B.plan_bfs(a, route=True)
+    jax.block_until_ready(plan.crows)
+    print(f"# plan: {time.perf_counter()-t0:.1f}s", flush=True)
+    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+    rp = rt.RoutePlan(rt.tile_masks(plan.route_masks[0, 0]), a.cap,
+                      npad, plan.route_compact)
+    return a, plan, rp, plan.starts_bits[0, 0], plan.valid_bits[0, 0], npad

@@ -11,19 +11,16 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from combblas_tpu.models import bfs as B
+import _bfs_fixture
 from combblas_tpu.ops import bitseg as bs
-from combblas_tpu.ops import generate
 from combblas_tpu.ops import route as rt
-from combblas_tpu.ops import semiring as S
-from combblas_tpu.parallel import distmat as dm
-from combblas_tpu.parallel.grid import ProcGrid
 
 
 def slope(label, make_f, args_of, K1=2, K2=32, reps=4):
@@ -48,25 +45,7 @@ def slope(label, make_f, args_of, K1=2, K2=32, reps=4):
 
 def main():
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else 20
-    n = 1 << scale
-    grid = ProcGrid.make(1, 1, jax.devices()[:1])
-    r, c = generate.rmat_edges(jax.random.key(1), scale, 16)
-    r, c = generate.symmetrize(r, c)
-    a = dm.from_global_coo(S.LOR, grid, r, c, jnp.ones_like(r, jnp.bool_),
-                           n, n, cap=int(0.98 * r.shape[0]))
-    del r, c
-    jax.block_until_ready(a.rows)
-    t0 = time.perf_counter()
-    plan = B.plan_bfs(a, route=True)
-    jax.block_until_ready(plan.crows)
-    print(f"# plan: {time.perf_counter()-t0:.1f}s", flush=True)
-
-    cap = a.cap
-    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
-    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad,
-                      plan.route_compact)
-    sb = plan.starts_bits[0, 0]
-    vb = plan.valid_bits[0, 0]
+    a, plan, rp, sb, vb, npad = _bfs_fixture.build(scale)
     nwords = npad >> 5
     print(f"# npad=2^{npad.bit_length()-1} compact={rp.compact}",
           flush=True)
@@ -75,12 +54,15 @@ def main():
         jnp.asarray(np.random.default_rng(0).integers(
             0, 2**32, nwords, dtype=np.uint32)))
 
+    # NB: rp/sb/vb are ARGS, never closure captures — a captured
+    # committed array is inlined as a jaxpr constant and shipped with
+    # the remote-compile request (424 MB of masks -> HTTP 413)
     def args_of(s):
-        return (base, jnp.uint32(s))
+        return (rp, sb, vb, base, jnp.uint32(s))
 
     def make_route(K):
         @jax.jit
-        def f(w, s):
+        def f(rp, sb, vb, w, s):
             w = w ^ s
             def body(i, w):
                 return rt.apply_route_best(rp, w)
@@ -89,7 +71,7 @@ def main():
 
     def make_fill(K):
         @jax.jit
-        def f(w, s):
+        def f(rp, sb, vb, w, s):
             w = w ^ s
             def body(i, w):
                 return bs.seg_or_fill_best(w, sb)
@@ -98,7 +80,7 @@ def main():
 
     def make_level(K):
         @jax.jit
-        def f(w, s):
+        def f(rp, sb, vb, w, s):
             new = w ^ s
             visited = new
             pcand = jnp.zeros_like(new)
@@ -113,10 +95,55 @@ def main():
             return new
         return f
 
+    def make_level_fused(K):
+        @jax.jit
+        def f(rp, sb, vb, w, s):
+            new = w ^ s
+            visited = new
+            pcand = jnp.zeros_like(new)
+            def body(i, carry):
+                new, visited, pcand = carry
+                hit = rt.apply_route_pallas(rp, new, and_mask=vb)
+                new2, visited, pcand, _ = bs.seg_or_fill_bfs_pallas(
+                    hit, sb, vb, visited, pcand)
+                return new2, visited, pcand
+            new, _, _ = lax.fori_loop(0, K, body, (new, visited, pcand))
+            return new
+        return f
+
+    # full per-root traversal (valid roots only): the loop + parent
+    # extraction; vs the level loop alone this exposes the tail cost
+    from combblas_tpu.models import bfs as B
+    deg = B.row_degrees(a)
+    degv = np.asarray(deg.reshape(-1))
+    cand = np.nonzero(degv > 0)[0]
+    roots_np = cand[np.random.default_rng(1).integers(0, len(cand), 64)]
+    roots_dev = jax.device_put(jnp.asarray(roots_np.astype(np.int32)))
+
+    def rargs_of(s):
+        return (a, plan, roots_dev, jnp.int32(s))
+
+    def make_traversal(K):
+        @jax.jit
+        def f(a, plan, rts, s):
+            def body(i, acc):
+                p = B.bfs_bits(a, rts[(s + i) % rts.shape[0]], plan)
+                return acc ^ p.data
+            return lax.fori_loop(0, K, body,
+                                 jnp.zeros((1, a.tile_m), jnp.int32))
+        return f
+
+    t_trav = float("nan")
+    if os.environ.get("PROFILE_TRAV"):
+        t_trav = slope("full traversal", make_traversal, rargs_of,
+                       K1=1, K2=5, reps=3)
     t_route = slope("route        ", make_route, args_of)
     t_fill = slope("seg_or_fill  ", make_fill, args_of)
-    t_level = slope("full level   ", make_level, args_of)
-    print(f"# glue = {1e3*(t_level - t_route - t_fill):.2f} ms", flush=True)
+    t_level = slope("level unfused", make_level, args_of)
+    t_lf = slope("level fused  ", make_level_fused, args_of)
+    print(f"# glue = {1e3*(t_level - t_route - t_fill):.2f} ms; "
+          f"fusion gain = {t_level/max(t_lf,1e-9):.2f}x; "
+          f"traversal = {t_trav*1e3:.1f} ms/root", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,7 +1,8 @@
 #!/usr/bin/env python
 """Per-level cost breakdown of the edge-space bit BFS on the real
 chip: for each level, frontier size (bits), route time, scan time.
-Guides the direction-optimization / mask-compaction decision.
+NB: per-call times here include the relay round trip; use
+profile_bfs_level22.py's slope timing for absolute kernel costs.
 
 Usage: python scripts/profile_bfs_levels.py [scale] [nroots]
 """
@@ -10,45 +11,24 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
+import _bfs_fixture
 from combblas_tpu.models import bfs as B
 from combblas_tpu.ops import bitseg as bs
 from combblas_tpu.ops import route as rt
-from combblas_tpu.ops import semiring as S
-from combblas_tpu.parallel import distmat as dm
-from combblas_tpu.parallel.grid import ProcGrid
 
 
 def main():
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else 20
     nroots = int(sys.argv[2]) if len(sys.argv) > 2 else 3
 
-    grid = ProcGrid.make(1, 1, jax.devices()[:1])
-    stats = None
-    from combblas_tpu.ops import generate
-    n = 1 << scale
-    r, c = generate.rmat_edges(jax.random.key(1), scale, 16)
-    r, c = generate.symmetrize(r, c)
-    a = dm.from_global_coo(S.LOR, grid, r, c, jnp.ones_like(r, jnp.bool_),
-                           n, n, cap=int(0.98 * (r.shape[0])))
-    del r, c
-    jax.block_until_ready(a.rows)
-    t0 = time.perf_counter()
-    plan = B.plan_bfs(a, route=True)
-    jax.block_until_ready(plan.crows)
-    print(f"# plan: {time.perf_counter()-t0:.1f}s", flush=True)
-
+    a, plan, rp, sb, vb, npad = _bfs_fixture.build(scale)
     cap = a.cap
-    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
-    rp = rt.RoutePlan(plan.route_masks[0, 0], cap, npad,
-                      plan.route_compact)
-    sb = plan.starts_bits[0, 0]
-    vb = plan.valid_bits[0, 0]
     rstarts = plan.rstarts[0, 0]
 
     route_j = jax.jit(lambda w: rt.apply_route_best(rp, w))

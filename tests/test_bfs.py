@@ -255,3 +255,25 @@ class TestStepperCrossCheck:
         ecs = [ec for ec, _ in tiers]
         assert ecs == sorted(ecs)
         assert all(ec >= 1024 for ec in ecs)
+
+
+def test_plan_route_more_rows_than_slots():
+    """A single-tile matrix with more rows than padded edge slots must
+    still plan (the start-compact parent-extract permutation cannot
+    exist there — code-review r4 regression: plan_bfs crashed)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel.grid import ProcGrid
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    r = jnp.asarray(np.array([0, 1, 200, 255], np.int32))
+    c = jnp.asarray(np.array([1, 0, 255, 200], np.int32))
+    a = dm.from_global_coo(S.LOR, grid, r, c, jnp.ones(4, bool),
+                           256, 256, cap=16)
+    plan = B.plan_bfs(a, route=True)
+    assert plan.colbits is None          # extract path correctly skipped
+    p = B.bfs_bits(a, jnp.int32(0), plan)
+    flat = np.asarray(p.data).reshape(-1)
+    assert flat[0] == 0 and flat[1] == 0 and flat[2] == -1

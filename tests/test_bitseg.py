@@ -114,15 +114,21 @@ def test_seg_or_scan_matches_numpy(rng, n, p):
     np.testing.assert_array_equal(gote.astype(bool), expect_ends)
 
 
-def test_fill_bfs_fused_tail_matches_composition(rng):
+@pytest.mark.parametrize("nrows", [
+    128,                  # one block, beyond-lane strides
+    BS._BLR * 2 + 128,    # 3 blocks + pad rows: cross-block carry,
+])                        # flag accumulation, and the pad branch
+def test_fill_bfs_fused_tail_matches_composition(rng, nrows):
     """The fused BFS level tail (seg_or_fill_bfs_pallas: backward fill
     + frontier update + parent-candidate accumulate + nonempty flag)
     is bit-identical to the unfused op composition it replaces."""
-    npad = 128 * 128 * 32            # one block, beyond-lane strides
+    npad = nrows * 128 * 32
     n = npad
     starts = np.zeros(n, bool)
     starts[0] = True
     starts[np.sort(rng.choice(n, 200, replace=False))] = True
+    # long runs straddling the 512-row block boundaries exercise the
+    # bwd carry; sparse hits exercise flag accumulation per block
     hit = rng.random(n) < 0.01
     vb = rng.random(n) < 0.9
     visited = rng.random(n) < 0.3
@@ -161,3 +167,40 @@ def test_route_and_mask_fusion(rng):
     got = np.asarray(R.apply_route_pallas(rp, words, interpret=True,
                                           and_mask=vb))
     np.testing.assert_array_equal(got, ref)
+
+
+def test_parent_planes_matches_numpy_model(rng):
+    """parent_planes_pallas: per row-segment, the output bitplanes at
+    the segment's START slot encode the column id of the HIGHEST set
+    pcand bit (rows are (row,col)-sorted, so highest bit = max col);
+    the last plane is 'row has a candidate'. Multi-block (cross-block
+    carries) and single-block cases."""
+    for nrows_w in (16, BS._BLR * 2 + 128):
+        npad = nrows_w * 128 * 32
+        n = npad
+        starts = np.zeros(n, bool)
+        starts[0] = True
+        starts[np.sort(rng.choice(n, 300, replace=False))] = True
+        seg = np.cumsum(starts) - 1
+        pcand = rng.random(n) < 0.003
+        nbits = 10
+        cols = rng.integers(0, 1 << nbits, n).astype(np.int64)
+        colbits = jnp.stack([
+            _pack(((cols >> b) & 1).astype(bool), npad)
+            for b in range(nbits)])
+        planes = BS.parent_planes_pallas(
+            _pack(pcand, npad), _pack(starts, npad), colbits,
+            interpret=True)
+        starts_idx = np.nonzero(starts)[0]
+        got_bits = [np.asarray(
+            (planes[b][starts_idx >> 5] >> (starts_idx & 31)) & 1)
+            for b in range(nbits + 1)]
+        for si, s0 in enumerate(starts_idx):
+            members = np.nonzero((seg == si) & pcand)[0]
+            has = int(len(members) > 0)
+            assert got_bits[nbits][si] == has, f"hasc seg {si}"
+            if has:
+                want = cols[members.max()]
+                got = sum(int(got_bits[b][si]) << b
+                          for b in range(nbits))
+                assert got == want, f"seg {si}: {got} != {want}"
