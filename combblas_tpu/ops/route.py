@@ -327,9 +327,48 @@ _RBLR = 512    # strip rows for the route kernel: every stage either
 #               Mosaic compile time explodes with the sublane extent
 
 
-def _route_kernel(m_ref, w_ref, *rest, mexp, nstages, blr, compact):
+def _mask_strip(m_ref, i, e, blr, half, compact):
+    """Full (blr, 128) mask for data strip ``i`` of stage-exponent
+    ``e`` — fetched directly, or decompacted from the 2:1 packed
+    top|shifted-bottom layout (see compact_masks)."""
     import jax.experimental.pallas as pl
     from combblas_tpu.ops.bitseg import _roll
+
+    if not compact:
+        return m_ref[0, pl.ds(i * blr, blr), :]
+    ci = jnp.where(i < half, i, i - half)
+    c = m_ref[0, pl.ds(ci * blr, blr), :]
+    top = i < half
+    if e < 5:
+        patt = jnp.uint32(_patt_word(e))
+        return jnp.where(top, c & patt, (c >> (1 << e)) & patt)
+    if e < 12:
+        dw = 1 << (e - 5)
+        lane = lax.broadcasted_iota(jnp.int32, (blr, 128), 1)
+        sel = jnp.where(top, c, _roll(c, -dw, 1))
+        return jnp.where((lane & dw) == 0, sel, jnp.uint32(0))
+    # in-strip row stage: 2*dr <= blr, so the local row index has
+    # the same dr-bit as the global one (strips are 2dr-aligned)
+    dr = 1 << (e - 12)
+    row = lax.broadcasted_iota(jnp.int32, (blr, 128), 0)
+    sel = jnp.where(top, c, _roll(c, -dr, 0))
+    return jnp.where((row & dr) == 0, sel, jnp.uint32(0))
+
+
+def _mask_strip_big(m_ref, lo, step, blr, half, compact):
+    """Mask strip for a `_big` (strip-pair) stage: a pair-lo strip is
+    all-valid rows; compact masks store it at strip `lo` (top half)
+    or `lo - half + step` (bottom: B[j] = C[j+dr])."""
+    import jax.experimental.pallas as pl
+
+    if compact:
+        cs = jnp.where(lo < half, lo, lo - half + step)
+        return m_ref[0, pl.ds(cs * blr, blr), :]
+    return m_ref[0, pl.ds(lo * blr, blr), :]
+
+
+def _route_kernel(m_ref, w_ref, *rest, mexp, nstages, blr, compact):
+    import jax.experimental.pallas as pl
 
     # optional AND-mask input (fused `route(w) & v` — saves a separate
     # elementwise kernel launch per BFS level): (m, w, v?, o).
@@ -349,28 +388,7 @@ def _route_kernel(m_ref, w_ref, *rest, mexp, nstages, blr, compact):
     k = jnp.abs(mexp - 1 - t)
 
     def mask_strip(i, e):
-        """Full (blr, 128) mask for data strip ``i`` of stage-exponent
-        ``e`` — fetched directly, or decompacted from the 2:1 packed
-        top|shifted-bottom layout (see compact_masks)."""
-        if not compact:
-            return m_ref[0, pl.ds(i * blr, blr), :]
-        ci = jnp.where(i < half, i, i - half)
-        c = m_ref[0, pl.ds(ci * blr, blr), :]
-        top = i < half
-        if e < 5:
-            patt = jnp.uint32(_patt_word(e))
-            return jnp.where(top, c & patt, (c >> (1 << e)) & patt)
-        if e < 12:
-            dw = 1 << (e - 5)
-            lane = lax.broadcasted_iota(jnp.int32, (blr, 128), 1)
-            sel = jnp.where(top, c, _roll(c, -dw, 1))
-            return jnp.where((lane & dw) == 0, sel, jnp.uint32(0))
-        # in-strip row stage: 2*dr <= blr, so the local row index has
-        # the same dr-bit as the global one (strips are 2dr-aligned)
-        dr = 1 << (e - 12)
-        row = lax.broadcasted_iota(jnp.int32, (blr, 128), 0)
-        sel = jnp.where(top, c, _roll(c, -dr, 0))
-        return jnp.where((row & dr) == 0, sel, jnp.uint32(0))
+        return _mask_strip(m_ref, i, e, blr, half, compact)
 
     @pl.when(t == 0)
     def _init():
@@ -408,14 +426,8 @@ def _route_kernel(m_ref, w_ref, *rest, mexp, nstages, blr, compact):
                     rhi = pl.ds((lo + step) * blr, blr)
                     a = o_ref[rlo, :]
                     b = o_ref[rhi, :]
-                    if compact:
-                        # a pair-lo strip is all-valid rows; its mask
-                        # sits at compact strip `lo` (top half) or
-                        # `lo - half + step` (bottom: B[j] = C[j+dr])
-                        cs = jnp.where(lo < half, lo, lo - half + step)
-                        mk = m_ref[0, pl.ds(cs * blr, blr), :]
-                    else:
-                        mk = m_ref[0, rlo, :]
+                    mk = _mask_strip_big(m_ref, lo, step, blr, half,
+                                         compact)
                     delta = (a ^ b) & mk
                     o_ref[rlo, :] = a ^ delta
                     o_ref[rhi, :] = b ^ delta
@@ -479,6 +491,102 @@ def apply_route_pallas(rp: RoutePlan, words: jax.Array,
         interpret=interpret,
     )(*args)
     return out.reshape(-1)
+
+
+def _route_kernel_pair(m_ref, w_ref, o_ref, *, mexp, blr, compact):
+    """Routes TWO independent bit planes through one mask stream —
+    the parent-extraction path routes 23 column-id planes through the
+    SAME network, and per-plane launches re-pay the full mask stream
+    each time (measured 51 ms for 23 singles vs 18 ms paired at
+    npad=2^27). P=2 keeps the resident W set (2 in + 2 out blocks)
+    inside the VMEM budget route_pallas_ok(extra_arrays=2) checks."""
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(0)
+    r = o_ref.shape[1]
+    nstrips = r // blr
+    half = nstrips // 2
+    k = jnp.abs(mexp - 1 - t)
+
+    @pl.when(t == 0)
+    def _init():
+        for q in range(2):
+            def body(i, _):
+                rows = pl.ds(i * blr, blr)
+                o_ref[q, rows, :] = w_ref[q, rows, :]
+                return 0
+
+            lax.fori_loop(0, nstrips, body, 0)
+
+    for e in range(mexp):
+        in_strip = e < 12 or 2 * (1 << (e - 12)) <= blr
+        if in_strip or nstrips == 1:
+            @pl.when(k == e)
+            def _small(e=e):
+                def body(i, _):
+                    rows = pl.ds(i * blr, blr)
+                    mk = _mask_strip(m_ref, i, e, blr, half, compact)
+                    for q in range(2):
+                        o_ref[q, rows, :] = _stage_swap(
+                            e, o_ref[q, rows, :], mk)
+                    return 0
+
+                lax.fori_loop(0, nstrips, body, 0)
+        else:
+            @pl.when(k == e)
+            def _big(e=e):
+                step = (1 << (e - 12)) // blr
+                def body(i, _):
+                    blk, off = i // step, i % step
+                    lo = blk * 2 * step + off
+                    rlo = pl.ds(lo * blr, blr)
+                    rhi = pl.ds((lo + step) * blr, blr)
+                    mk = _mask_strip_big(m_ref, lo, step, blr, half,
+                                         compact)
+                    for q in range(2):
+                        a = o_ref[q, rlo, :]
+                        b = o_ref[q, rhi, :]
+                        delta = (a ^ b) & mk
+                        o_ref[q, rlo, :] = a ^ delta
+                        o_ref[q, rhi, :] = b ^ delta
+                    return 0
+
+                lax.fori_loop(0, nstrips // 2, body, 0)
+
+
+def apply_route_pallas_pair(rp: RoutePlan, words2: jax.Array,
+                            interpret: bool = False) -> jax.Array:
+    """Route a (2, npad/32) pair of planes through one kernel launch
+    (one shared mask stream). Bit-identical to routing each plane
+    with apply_route_pallas."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = rp.npad.bit_length() - 1
+    nstages = rp.nstages
+    nwords = rp.npad >> 5
+    r = max(nwords // 128, 1)
+    w3 = words2.reshape(2, r, 128)
+    mr = r // 2 if rp.compact else r
+    m3 = rp.masks.reshape(nstages, mr, 128)
+    kernel = functools.partial(_route_kernel_pair, mexp=m,
+                               blr=min(_RBLR, mr), compact=rp.compact)
+    out = pl.pallas_call(
+        kernel,
+        grid=(nstages,),
+        in_specs=[
+            pl.BlockSpec((1, mr, 128), lambda t: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, r, 128), lambda t: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2, r, 128), lambda t: (0, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=_sds((2, r, 128), jnp.uint32, words2),
+        compiler_params=_vmem_params(),
+        interpret=interpret,
+    )(m3, w3)
+    return out.reshape(2, -1)
 
 
 def _device_vmem_bytes() -> int:
