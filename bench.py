@@ -3,9 +3,11 @@
 config — Graph500 BFS GTEPS (scale 22, edgefactor 16, 64 roots, one
 spec-validated root) and R-MAT A*A SpGEMM nnz/sec/chip.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N,
-   "extra_metrics": [{... spgemm nnz/sec/chip ...}], ...}
+Output protocol (round 5, after BENCH_r04's parsed:null): every extra
+metric and every verbose detail prints as its OWN JSON line first; the
+LAST stdout line is a SHORT headline
+  {"metric": ..., "value": N, "unit": "GTEPS", "vs_baseline": N, ...}
+so a tail-capturing driver always gets the headline intact.
 
 vs_baseline compares the BFS median against the reference's strongest
 committed in-tree log at the SAME config: 173.0 MTEPS median, Graph500
@@ -34,8 +36,12 @@ def bench_bfs(args):
                            edgefactor=args.edgefactor,
                            nroots=args.nroots,
                            validate_roots=args.validate_roots,
+                           root_windows=args.root_windows,
                            verbose=args.verbose)
-    return stats.summary()
+    s = stats.summary()
+    s["window_times_s"] = [round(t, 4) for t in stats.window_times]
+    s["window_sizes"] = stats.window_sizes
+    return s
 
 
 def bench_spgemm(args):
@@ -179,6 +185,12 @@ def main():
     ap.add_argument("--validate-roots", type=int, default=8,
                     help="spec-validate this many roots (untimed; the "
                          "on-device validator makes >= 8 cheap)")
+    ap.add_argument("--root-windows", type=int, default=8,
+                    help="timing windows for the Graph500 roots: each "
+                         "window is dispatched back-to-back and timed "
+                         "as one unit (pays one relay round trip); "
+                         "min/quartile/median stats are real spread "
+                         "over windows")
     ap.add_argument("--spgemm-scale", type=int, default=16,
                     help="A*A benchmark scale (largest single-chip scale "
                          "whose full C fits the 16 GB HBM; baseline "
@@ -281,23 +293,28 @@ def main():
         except Exception as e:
             extra.append({"metric": "mcl_bench_error", "error": str(e)})
     else:
-        # embed the recorded end-to-end measurement (same machine,
-        # this round) instead of re-running it inside the bench window
+        # embed the newest recorded end-to-end measurement (same
+        # machine) instead of re-running it inside the bench window
         try:
+            import glob
             import os
-            with open(os.path.join(os.path.dirname(
-                    os.path.abspath(__file__)), "MCL_BENCH_r04.json")) as f:
-                extra.append({**json.load(f), "recorded": True})
+            cands = sorted(glob.glob(os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "MCL_BENCH_r*.json")))
+            with open(cands[-1]) as f:
+                extra.append({**json.load(f), "recorded": True,
+                              "recorded_file": os.path.basename(cands[-1])})
         except Exception as e:
             extra.append({"metric": "mcl_recorded_result_missing",
                           "error": str(e)[:200]})
 
+    # one JSON line per extra metric / detail record FIRST; the LAST
+    # line is the short headline (the driver's tail capture must
+    # always contain it — BENCH_r04 lost the headline to one giant
+    # line, VERDICT r4 missing #3)
+    for m in extra:
+        print(json.dumps({"record": "extra_metric", **m}))
     print(json.dumps({
-        "metric": f"graph500_bfs_scale{args.scale}_ef{args.edgefactor}_"
-                  f"{nchips}chip_median",
-        "value": round(gteps, 4),
-        "unit": "GTEPS",
-        "vs_baseline": round(gteps / BASELINE_GTEPS, 3),
+        "record": "bfs_detail",
         "baseline": f"{BASELINE_GTEPS} GTEPS median, Graph500 scale-22 "
                     "ef16, 64 MPI ranks (CarverResults/scale22_p64_july11"
                     ".run)" + (
@@ -305,20 +322,36 @@ def main():
                         f"{args.scale}; the ratio is not a same-config "
                         "comparison" if args.scale != requested_scale
                         else ""),
+        "q1_gteps": round(s["q1_teps"] / 1e9, 4),
+        "q3_gteps": round(s["q3_teps"] / 1e9, 4),
+        "max_gteps": round(s["max_teps"] / 1e9, 4),
+        "window_times_s": s["window_times_s"],
+        "window_sizes": s["window_sizes"],
+        "timing": f"{s['n_windows']} timing windows; each window's "
+                  "roots dispatched back-to-back with async stats "
+                  "readback, wall time = [first dispatch, last "
+                  "arrival] (includes ONE relay round trip per window "
+                  "— conservative); per-root time = window/size; "
+                  "min/quartile/median/harmonic stats are computed "
+                  "over the windows' per-root rates, i.e. real spread "
+                  "(TopDownBFS.cpp:452-524 recipe); see models/bfs.py "
+                  "graph500_run",
+        **({"fallback_reason": str(last_err)[:300]}
+           if args.scale != requested_scale else {}),
+    }))
+    print(json.dumps({
+        "metric": f"graph500_bfs_scale{args.scale}_ef{args.edgefactor}_"
+                  f"{nchips}chip_median",
+        "value": round(gteps, 4),
+        "unit": "GTEPS",
+        "vs_baseline": round(gteps / BASELINE_GTEPS, 3),
         "nroots": args.nroots,
         "validated_roots": args.validate_roots,
+        "n_windows": s["n_windows"],
         "min_gteps": round(s["min_teps"] / 1e9, 4),
         "harmonic_mean_gteps": round(s["harmonic_mean_teps"] / 1e9, 4),
-        "timing": "all roots dispatched up-front; per-root time = "
-                  "(last-stats-arrival - first-dispatch)/nroots, which "
-                  "includes ONE relay round trip (conservative) but not "
-                  "the ~100ms/root WAN latency a sync readback per root "
-                  "would add (the reference's MPI_Wtime has no such "
-                  "link); see models/bfs.py graph500_run",
-        **({"requested_scale": requested_scale,
-            "fallback_reason": str(last_err)[:300]}
+        **({"requested_scale": requested_scale}
            if args.scale != requested_scale else {}),
-        "extra_metrics": extra,
     }))
 
 

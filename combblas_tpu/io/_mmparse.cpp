@@ -14,6 +14,14 @@
 #include <cstdlib>
 #include <cstring>
 #include <cctype>
+#include <cerrno>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 namespace {
 
@@ -139,6 +147,135 @@ long long mm_read_body(const char* path, int* rows, int* cols, double* vals,
   }
   fclose(f);
   return count;
+}
+
+// Byte-range-parallel body read (the reference's ParallelReadMM recipe,
+// SpParMat.cpp:3922 + SpParHelper.h:110 check_newline, with threads in
+// the role of MPI ranks): mmap the file, split the data region into
+// nthreads byte ranges, fix each range start to the next line boundary,
+// then two parallel passes — count records per range, prefix-sum the
+// output offsets, parse in place with strtol/strtod (no per-line copy).
+// A record belongs to the range containing its line's first byte; the
+// last line of a range may be read past the range end.
+// Returns entries read, or a negative error code.
+long long mm_read_body_par(const char* path, int* rows, int* cols,
+                           double* vals, long long max_nnz, int nthreads) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  Banner b;
+  if (!parse_banner(f, &b) || !b.coordinate) { fclose(f); return -2; }
+  if (!skip_comments(f)) { fclose(f); return -3; }
+  long long m, n, nnz;
+  if (fscanf(f, "%lld %lld %lld", &m, &n, &nnz) != 3) { fclose(f); return -4; }
+  int ch;
+  while ((ch = fgetc(f)) != EOF && ch != '\n') {}
+  long data_start = ftell(f);
+  fclose(f);
+
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -1; }
+  size_t fsize = (size_t)st.st_size;
+  if ((size_t)data_start >= fsize) { close(fd); return 0; }
+  char* base = (char*)mmap(nullptr, fsize, PROT_READ, MAP_PRIVATE, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return -6;
+
+  if (nthreads < 1) nthreads = 1;
+  size_t span = fsize - (size_t)data_start;
+  if (span / 65536 + 1 < (size_t)nthreads)
+    nthreads = (int)(span / 65536 + 1);   // tiny files: fewer ranges
+
+  // range boundaries, snapped forward to line starts (check_newline)
+  std::vector<size_t> lo(nthreads + 1);
+  for (int t = 0; t <= nthreads; ++t) {
+    size_t p = (size_t)data_start + span * (size_t)t / (size_t)nthreads;
+    if (t > 0 && t < nthreads) {
+      const char* nl = (const char*)memchr(base + p, '\n', fsize - p);
+      p = nl ? (size_t)(nl - base) + 1 : fsize;
+    }
+    lo[t] = (t == nthreads) ? fsize : p;
+  }
+
+  // is this line (starting at p) a record? (skip blanks/comments)
+  auto is_record = [&](size_t p) {
+    while (p < fsize && (base[p] == ' ' || base[p] == '\t')) ++p;
+    return p < fsize && base[p] != '\n' && base[p] != '\r' &&
+           base[p] != '%';
+  };
+
+  std::vector<long long> counts(nthreads, 0);
+  std::vector<int> errs(nthreads, 0);
+
+  auto count_pass = [&](int t) {
+    long long c = 0;
+    for (size_t p = lo[t]; p < lo[t + 1]; ) {
+      if (is_record(p)) ++c;
+      const char* nl = (const char*)memchr(base + p, '\n', fsize - p);
+      p = nl ? (size_t)(nl - base) + 1 : fsize;
+    }
+    counts[t] = c;
+  };
+  {
+    std::vector<std::thread> ths;
+    for (int t = 0; t < nthreads; ++t) ths.emplace_back(count_pass, t);
+    for (auto& th : ths) th.join();
+  }
+  std::vector<long long> offs(nthreads + 1, 0);
+  for (int t = 0; t < nthreads; ++t) offs[t + 1] = offs[t] + counts[t];
+  long long total = offs[nthreads];
+  if (total > max_nnz) { munmap(base, fsize); return -7; }
+
+  bool pattern = b.pattern;
+  auto parse_pass = [&](int t) {
+    long long i = offs[t];
+    for (size_t p = lo[t]; p < lo[t + 1]; ) {
+      const char* nl = (const char*)memchr(base + p, '\n', fsize - p);
+      size_t next = nl ? (size_t)(nl - base) + 1 : fsize;
+      if (is_record(p)) {
+        // strtol stops at the newline; reading past the range end is
+        // fine (the map extends to fsize and lines never cross it).
+        // A final line with no newline could run off the map when
+        // fsize is page-aligned — bounce it through a local buffer.
+        char tail[4096];
+        char* q = base + p;
+        if (!nl) {
+          size_t len = fsize - p;
+          if (len >= sizeof tail) len = sizeof tail - 1;
+          memcpy(tail, base + p, len);
+          tail[len] = '\0';
+          q = tail;
+        }
+        char* end;
+        long r = strtol(q, &end, 10);
+        if (end == q) { errs[t] = 1; return; }
+        q = end;
+        long c = strtol(q, &end, 10);
+        if (end == q) { errs[t] = 1; return; }
+        double v = 1.0;
+        if (!pattern) {
+          q = end;
+          v = strtod(q, &end);
+          if (end == q) { errs[t] = 1; return; }
+        }
+        rows[i] = (int)(r - 1);
+        cols[i] = (int)(c - 1);
+        vals[i] = v;
+        ++i;
+      }
+      p = next;
+    }
+  };
+  {
+    std::vector<std::thread> ths;
+    for (int t = 0; t < nthreads; ++t) ths.emplace_back(parse_pass, t);
+    for (auto& th : ths) th.join();
+  }
+  munmap(base, fsize);
+  for (int t = 0; t < nthreads; ++t)
+    if (errs[t]) return -5;
+  return total;
 }
 
 // Write a coordinate file (real general). Returns 0 ok.

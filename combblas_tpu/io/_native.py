@@ -29,6 +29,11 @@ def _configure(lib):
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
         ctypes.c_longlong]
+    lib.mm_read_body_par.restype = ctypes.c_longlong
+    lib.mm_read_body_par.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_longlong, ctypes.c_int]
     lib.mm_write.restype = ctypes.c_int
     lib.mm_write.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),

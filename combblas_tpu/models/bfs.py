@@ -116,13 +116,35 @@ class BfsPlan:
     symmetric: bool = dataclasses.field(default=False,
                                         metadata=dict(static=True))
     # whether route_masks are stored 2:1-packed (route.compact_masks);
-    # npad is then shape[-1]*64, not *32
+    # npad is then words*64, not *32. Mask tensors are stored PRE-TILED
+    # — (pr, pc, nstages, words/128, 128) — whenever words % 128 == 0:
+    # the flat->tiled reshape is a full relayout copy on TPU (~424 MB
+    # of mask traffic at scale 22), and storing flat made every root's
+    # traversal re-pay it (ADVICE r4). `_mask_words` abstracts the two
+    # layouts.
     route_compact: bool = dataclasses.field(default=False,
                                             metadata=dict(static=True))
 
     @property
     def chunk_len(self) -> int:
         return self.cols_t.shape[-1] // 128
+
+
+def _mask_words(masks: jax.Array) -> int:
+    """uint32 word count of one stored mask row, for either layout:
+    (pr, pc, nstages, words) flat or (pr, pc, nstages, words/128, 128)
+    pre-tiled (see BfsPlan.route_compact note)."""
+    if masks.ndim == 5:
+        return masks.shape[-2] * masks.shape[-1]
+    return masks.shape[-1]
+
+
+def _tile_mask_tensor(masks: np.ndarray) -> np.ndarray:
+    """Pre-tile a (..., nstages, words) mask tensor to the Pallas
+    operand layout (..., nstages, words/128, 128) when possible."""
+    if masks.shape[-1] % 128 == 0:
+        return masks.reshape(*masks.shape[:-1], -1, 128)
+    return masks
 
 
 @jax.jit
@@ -187,13 +209,15 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
     for i in range(pr):
         for j in range(pc):
             tiles.append(_cached_route_masks(c2r[i, j], compact))
-    masks = np.stack(tiles).reshape(pr, pc, *tiles[0].shape)
+    npad_r = rt.mask_npad(tiles[0].shape[-1], compact)
+    masks = _tile_mask_tensor(np.stack(tiles).reshape(
+        pr, pc, *tiles[0].shape))
     # device_put straight from numpy: resharding an already-committed
     # array would stage the full mask tensor on one device first — an
     # HBM spike at exactly the scales routing is for
     masks = jax.device_put(
-        masks, a.grid.sharding(ROW_AXIS, COL_AXIS, None, None))
-    npad_r = rt.mask_npad(masks.shape[-1], compact)
+        masks, a.grid.sharding(ROW_AXIS, COL_AXIS,
+                               *([None] * (masks.ndim - 2))))
     sb, vb, rs = _bit_structure(a, npad_r)
     cb = _col_bit_structure(plan.ccols, a.nnz, a.grid, npad_r)
     sym = False
@@ -229,14 +253,28 @@ def _plan_parent_extract(a: dm.DistSpMat, plan: BfsPlan, npad: int,
         for b in range(nbits)])
     rstarts = np.asarray(plan.rstarts[0, 0])
     nonempty = rstarts[1:] > rstarts[:-1]
-    rows_ne = np.nonzero(nonempty)[0].astype(np.int64)
-    src = rstarts[:-1][nonempty].astype(np.int64)
-    perm = np.full(npad, -1, np.int64)
+    rows_ne = np.flatnonzero(nonempty).astype(np.int32)
+    src = rstarts[:-1][nonempty].astype(np.int32)
+    perm = np.full(npad, -1, np.int32)
     perm[src] = rows_ne
-    free_dst = np.setdiff1d(np.arange(npad, dtype=np.int64), rows_ne,
-                            assume_unique=False)
-    perm[perm < 0] = free_dst[:int((perm < 0).sum())]
-    srt = _cached_route_masks(perm.astype(np.int32), compact)
+    # filler destinations = row ids NOT already used, via a boolean
+    # occupancy mask + chunked int32 flatnonzero — the int64 arange +
+    # setdiff1d sort this replaces was ~12 GB of transient host memory
+    # at scale 24 (npad = 2^29), undermining the chunked-ingestion
+    # memory story (ADVICE r4)
+    occupied = np.zeros(npad, bool)
+    occupied[rows_ne] = True
+    free_dst = np.empty(npad - len(rows_ne), np.int32)
+    o = 0
+    ch = 1 << 24
+    for s in range(0, npad, ch):
+        f = np.flatnonzero(~occupied[s:s + ch])
+        free_dst[o:o + f.size] = (f + s).astype(np.int32)
+        o += f.size
+    del occupied
+    perm[perm < 0] = free_dst
+    del free_dst
+    srt = _tile_mask_tensor(_cached_route_masks(perm, compact))
     nwm = -(-tile_m // 32)
     rnon = np.asarray(rt.pack_bits(jnp.asarray(nonempty.astype(np.int8)),
                                    nwm * 32))
@@ -464,7 +502,7 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
     # (~3x cheaper than the equivalent gather, but ~30x the traffic of
     # the bit route), then (3) max-scanned per row.
     use_route = plan.route_masks is not None
-    npad = (rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+    npad = (rt.mask_npad(_mask_words(plan.route_masks), plan.route_compact)
             if use_route else 0)
 
     def dense_step(act):
@@ -510,11 +548,12 @@ def build_steppers(a: dm.DistSpMat, plan: BfsPlan):
 
         rmasks = (plan.route_masks if use_route else
                   jnp.zeros((grid.pr, grid.pc, 1, 1), jnp.uint32))
+        rspec = P(ROW_AXIS, COL_AXIS, *([None] * (rmasks.ndim - 2)))
         return jax.shard_map(
             f, mesh=mesh,
             in_specs=(spec3,) * 4 + (spec3, P(ROW_AXIS, COL_AXIS, None),
                                      spec3, spec3, spec3,
-                                     P(ROW_AXIS, COL_AXIS, None, None),
+                                     rspec,
                                      spec_act),
             out_specs=spec_y,
         )(plan.cols_t, plan.starts_t, plan.valid_t, plan.ends_m,
@@ -700,7 +739,7 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
             f"{(a.grid.pr, a.grid.pc, a.cap, a.tile_m, a.tile_n)}: the "
             "plan was built for a different matrix")
     cap, tile_m = a.cap, a.tile_m
-    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+    npad = rt.mask_npad(_mask_words(plan.route_masks), plan.route_compact)
     nwords = npad >> 5
     rp = rt.RoutePlan(rt.tile_masks(plan.route_masks[0, 0]), cap, npad,
                       plan.route_compact)
@@ -881,7 +920,7 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     grid = a.grid
     pr, pc = grid.pr, grid.pc
     cap, tile_m, tile_n = a.cap, a.tile_m, a.tile_n
-    npad = rt.mask_npad(plan.route_masks.shape[-1], plan.route_compact)
+    npad = rt.mask_npad(_mask_words(plan.route_masks), plan.route_compact)
     nwv = -(-tile_m // 32)               # vertex-bit words per block
     root = jnp.asarray(root, jnp.int32)
     capp = plan.cols_t.shape[-1]
@@ -981,10 +1020,11 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
         return parents[None]
 
     spec3 = P(ROW_AXIS, COL_AXIS, None)
+    rspec = P(ROW_AXIS, COL_AXIS,
+              *([None] * (plan.route_masks.ndim - 2)))
     parents = jax.shard_map(
         f, mesh=grid.mesh,
-        in_specs=(spec3,) * 7 + (P(ROW_AXIS, COL_AXIS, None, None),)
-        + (spec3,) * 4,
+        in_specs=(spec3,) * 7 + (rspec,) + (spec3,) * 4,
         out_specs=P(ROW_AXIS, None),
     )(plan.cols_t, plan.starts_t, plan.valid_t, plan.ends_m, plan.nonempty,
       plan.cstarts, plan.cdeg, plan.route_masks, plan.starts_bits,
@@ -1130,15 +1170,24 @@ class BfsRunStats:
     teps: list
     times: list
     visited: list
+    # wall time of each dispatch->drain window and how many roots it
+    # covered — the unit of genuine measurement on a tunneled TPU
+    # (per-root arrival deltas are relay artifacts, see graph500_run)
+    window_times: list = dataclasses.field(default_factory=list)
+    window_sizes: list = dataclasses.field(default_factory=list)
 
     def summary(self) -> dict:
         teps = np.asarray(self.teps)
+        q1, q3 = float(np.quantile(teps, 0.25)), float(np.quantile(teps, 0.75))
         return {
             "min_teps": float(teps.min()),
+            "q1_teps": q1,
             "median_teps": float(np.median(teps)),
+            "q3_teps": q3,
             "max_teps": float(teps.max()),
             "harmonic_mean_teps": float(1.0 / np.mean(1.0 / teps)),
             "mean_time": float(np.mean(self.times)),
+            "n_windows": len(self.window_times),
         }
 
 
@@ -1146,7 +1195,7 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
                  nroots: int = 16, seed: int = 1, cap_slack: float = 0.98,
                  validate: bool = False, validate_roots: int = 0,
                  alpha: int = 8, route: bool | str = "auto",
-                 route_budget_s: float = 900.0,
+                 route_budget_s: float = 900.0, root_windows: int = 8,
                  verbose: bool = False) -> BfsRunStats:
     """End-to-end Graph500 kernel-2 harness: generate R-MAT, build the
     symmetric adjacency matrix, run BFS from random roots, report TEPS
@@ -1241,15 +1290,23 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     # warm-up compile (not timed, like the reference's untimed iteration 0)
     _ = np.asarray(run_with_stats(a, plan, deg, jnp.int32(roots[0]))[1])
 
-    # Pipelined per-root timing. A tunneled TPU pays a ~85-120 ms relay
+    # Windowed per-root timing. A tunneled TPU pays a ~85-120 ms relay
     # round trip on every synchronous stats readback; timing
     # dispatch->readback per root adds that constant WAN latency to
     # every measurement (the reference's MPI_Wtime around each search
-    # has no such link, TopDownBFS.cpp:437). Instead ALL roots are
-    # dispatched up front with their 2-scalar stats put on the async
-    # copy-back stream at dispatch time, and ONE window is measured
-    # (see the note below the drain loop). Memory stays flat: parents
-    # buffers are dropped at dispatch except for the validated roots.
+    # has no such link, TopDownBFS.cpp:437), and individual arrival
+    # deltas are relay artifacts (results arrive in bursts). The unit
+    # of genuine measurement is a WINDOW: the roots are split into
+    # ``root_windows`` batches; each batch is dispatched back-to-back
+    # with its 2-scalar stats on the async copy-back stream and the
+    # [first-dispatch, last-arrival] wall time is recorded per batch.
+    # Each batch pays ONE relay round trip (conservative: it inflates,
+    # never deflates, the reported times), and the min/quartile/median/
+    # harmonic statistics over batches are REAL spread — restoring the
+    # Graph500 recipe's distribution reporting (TopDownBFS.cpp:452-524)
+    # that a single all-roots window degenerates to one number.
+    # Memory stays flat: parents buffers are dropped at dispatch
+    # except for the validated roots.
     queue: list = []    # (root_idx, parents|None, stats)
 
     def dispatch(ri, root):
@@ -1262,34 +1319,31 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
         queue.append((ri, keep_p, vn))
 
     vparents: dict = {}
-    t_start = time.perf_counter()   # chip is idle (warm-up synced)
-    for ri, root in enumerate(roots):
-        dispatch(ri, root)
-    per_root: list = []
-    while queue:
-        ri, kp, vn = queue.pop(0)
-        vnv = np.asarray(vn)                    # waits for arrival
-        per_root.append((int(vnv[0]), int(vnv[1])))
-        if kp is not None:
-            vparents[ri] = kp
-    t_end = time.perf_counter()
-    # the [dispatch, last arrival] window covers the nroots sequential
-    # executions plus ONE relay round trip (uplink of the first
-    # dispatch + downlink of the last result) — ~1% conservative at
-    # bench scale, and immune to the relay's bursty result delivery
-    # (individual arrival deltas are NOT usable: results arrive in
-    # batches). Each root is assigned the uniform T/nroots; device
-    # searches are near-iid on R-MAT (every root reaches the same
-    # giant component).
-    dt = (t_end - t_start) / max(1, len(per_root))
-    for ri, (visited, nedges) in enumerate(per_root):
-        stats.teps.append(nedges / dt)
-        stats.times.append(dt)
-        stats.visited.append(visited)
-        if verbose:
-            print(f"root {int(roots[ri])}: {visited} visited, "
-                  f"{nedges} edges, {dt*1e3:.1f} ms (uniform), "
-                  f"{nedges/dt/1e6:.1f} MTEPS", flush=True)
+    nwin = max(1, min(root_windows, len(roots)))
+    windows = np.array_split(np.arange(len(roots)), nwin)
+    for w in windows:
+        t0 = time.perf_counter()   # chip is idle (previous batch drained)
+        for ri in w:
+            dispatch(int(ri), roots[int(ri)])
+        per_root: list = []
+        while queue:
+            ri, kp, vn = queue.pop(0)
+            vnv = np.asarray(vn)                    # waits for arrival
+            per_root.append((ri, int(vnv[0]), int(vnv[1])))
+            if kp is not None:
+                vparents[ri] = kp
+        t_win = time.perf_counter() - t0
+        stats.window_times.append(t_win)
+        stats.window_sizes.append(len(w))
+        dt = t_win / max(1, len(per_root))
+        for ri, visited, nedges in per_root:
+            stats.teps.append(nedges / dt)
+            stats.times.append(dt)
+            stats.visited.append(visited)
+            if verbose:
+                print(f"root {int(roots[ri])}: {visited} visited, "
+                      f"{nedges} edges, {dt*1e3:.1f} ms (window avg), "
+                      f"{nedges/dt/1e6:.1f} MTEPS", flush=True)
 
     # validation (untimed, after the timed stream — kernel-2
     # verification is outside the clock either way)

@@ -306,10 +306,42 @@ def _bucket_fine(x: int, floor: int = 4096) -> int:
     return base + step * (-(-(x - base) // step)) if x > base else base
 
 
+class CapLadder:
+    """Sticky capacity rungs for iterated pipelines (MCL's expansion,
+    VERDICT r4 missing #1). ``fit(x)`` reuses an already-minted rung
+    within ``slack``× of the request instead of cutting a fresh
+    quarter-octave bucket, so iterations 2..N of a monotonically
+    shrinking pipeline (prune makes MCL's nnz fall every iteration)
+    land on iteration-1 shapes and hit the jit cache. On a remote-
+    compile host one avoided recompile (~tens of seconds) dwarfs the
+    ≤ ``slack``× padded-slot compute it costs (device kernels at MCL
+    scales run in milliseconds). New rungs are minted only when no
+    existing rung is within slack — at most O(log_slack(range)) per
+    call-site over a whole run."""
+
+    def __init__(self, slack: float = 8.0, floor: int = 4096):
+        self.rungs: list[int] = []
+        self.slack = slack
+        self.floor = floor
+
+    def fit(self, x: int, floor: Optional[int] = None) -> int:
+        fl = self.floor if floor is None else floor
+        x = max(int(x), fl, 1)
+        for r in sorted(self.rungs):
+            if x <= r <= x * self.slack:
+                return r
+        rung = _bucket_fine(x, fl)
+        if rung not in self.rungs:
+            self.rungs.append(rung)
+        return rung
+
+
 def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
                     phases: Optional[int] = None,
                     phase_flop_budget: int = 2 ** 26,
-                    cap_round: int = 4096) -> list[tuple[int, int, int, int]]:
+                    cap_round: int = 4096,
+                    cap_ladder: Optional[CapLadder] = None,
+                    ) -> list[tuple[int, int, int, int]]:
     """Single-tile phase plan: ONE host fetch of each operand's
     structure, exact per-B-column flop counts, balanced-flop window
     boundaries. Returns [(clo, chi, flops_cap, out_cap)] with caps
@@ -352,24 +384,43 @@ def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
         oc = min(max(f, 1), a.tile_m * (hi - lo))
         # clamp the bucket, not the flop count: f <= _SAT always fits,
         # only the rounded-up bucket can cross the guard
-        windows.append((lo, hi, min(_bucket_fine(max(f, 1), cap_round), _SAT),
-                        min(_bucket_fine(oc, cap_round), _SAT)))
+        fit = cap_ladder.fit if cap_ladder is not None else _bucket_fine
+        windows.append((lo, hi, min(fit(max(f, 1), cap_round), _SAT),
+                        min(fit(oc, cap_round), _SAT)))
     return windows
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2))
+def _place3(dr, dc, dv, off, sr_, sc_, sv_):
+    """Copy one part's full buffer (live prefix + sentinel padding)
+    into the accumulator at ``off``. Donated: in-place on TPU."""
+    return (lax.dynamic_update_slice(dr, sr_, (off,)),
+            lax.dynamic_update_slice(dc, sc_, (off,)),
+            lax.dynamic_update_slice(dv, sv_, (off,)))
 
 
 def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                 phases: Optional[int], phase_flop_budget: int,
                 prune_hook, out_cap: Optional[int],
-                cap_round: int) -> DistSpMat:
+                cap_round: int,
+                cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
     """Single-tile phased SpGEMM: plan once on host (ONE fetch of each
     operand's structure), then run every phase through one compiled
     dynamic-window kernel (`tile.spgemm_colwindow`). No per-phase host
     planning, no B-window materialization, no device_put round-trips —
     the round-3 path spent ~10x the kernel time on those.
+
+    Phase results accumulate by PLACEMENT (dynamic_update_slice at the
+    running live offset — the banded-ingester pattern), not by
+    iterated concat-sorts: phases cover disjoint output columns, so
+    the only reorder needed is ONE final (row, col) sort. The round-4
+    fold-every-8 policy re-sorted the accumulated output repeatedly —
+    1.45 s of a 14.6 s scale-16 multiply (VERDICT r4 weak #5/#7).
     """
     from combblas_tpu.utils import timing as tm
     t_ = tm.GLOBAL
     grid = a.grid
+    fit = cap_ladder.fit if cap_ladder is not None else _bucket_fine
     at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
                  a.tile_m, a.tile_n)
     bt = tl.Tile(b.rows[0, 0], b.cols[0, 0], b.vals[0, 0], b.nnz[0, 0],
@@ -377,29 +428,16 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     with t_.phase("spgemm_plan"):
         windows = plan_colwindows(a, b, phases=phases,
                                   phase_flop_budget=phase_flop_budget,
-                                  cap_round=cap_round)
+                                  cap_round=cap_round,
+                                  cap_ladder=cap_ladder)
 
     def wrap(t: tl.Tile) -> DistSpMat:
         return DistSpMat(t.rows[None, None], t.cols[None, None],
                          t.vals[None, None], t.nnz[None, None],
                          grid, a.nrows, b.ncols, t.nrows, t.ncols)
 
-    parts: list[tl.Tile] = []
-
-    def fold(parts: list[tl.Tile], cap: Optional[int]) -> tl.Tile:
-        rows = jnp.concatenate([t.rows for t in parts])
-        cols = jnp.concatenate([t.cols for t in parts])
-        vals = jnp.concatenate([t.vals for t in parts])
-        nlive = sum(t.nnz for t in parts)
-        if cap is None:
-            cap = _bucket_fine(int(np.asarray(nlive)), cap_round)
-        # phases cover disjoint output columns: concat + one sort, no
-        # dedup pass (sort_compress's no-dedup path is a single sort)
-        t, _ = tl.sort_compress(sr.add, rows, cols, vals, nlive,
-                                nrows=a.tile_m, ncols=b.tile_n, cap=cap,
-                                dedup=False)
-        return t
-
+    acc = None          # (rows, cols, vals) sentinel-padded, unsorted
+    nlive = 0           # host-known live prefix of acc
     for (lo, hi, fc, oc) in windows:
         with t_.phase("local"):
             cp = tl.spgemm_colwindow(
@@ -409,17 +447,43 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             with t_.phase("prune"):
                 cp = _unwrap_1x1(prune_hook(wrap(cp)))
         # shrink to the true output size: out_cap above is flops-bounded
-        # (~2-4x the deduped nnz on power-law graphs), and holding
-        # several flops-sized parts OOMs the 16 GB HBM at scale >= 16.
-        # One scalar readback per phase buys a bounded working set.
+        # (~2-4x the deduped nnz on power-law graphs), and holding the
+        # flops-sized buffer OOMs the 16 GB HBM at scale >= 16. One
+        # scalar readback per phase buys a bounded working set — and
+        # makes the placement offsets host-known.
+        pn = int(np.asarray(cp.nnz))
         with t_.phase("local"):
-            cp = cp.with_capacity(_bucket_fine(int(np.asarray(cp.nnz)), 128))
-        parts.append(cp)
-        if len(parts) >= 8:
-            with t_.phase("merge"):
-                parts = [fold(parts, None)]
+            cp = cp.with_capacity(fit(pn, 128))
+        with t_.phase("merge"):
+            need_buf = nlive + cp.cap    # placement writes cp's padding too
+            if acc is None:
+                ac_cap = fit(need_buf, cap_round)
+                acc = (jnp.full((ac_cap,), a.tile_m, jnp.int32),
+                       jnp.full((ac_cap,), b.tile_n, jnp.int32),
+                       jnp.zeros((ac_cap,), cp.vals.dtype))
+            elif acc[0].shape[0] < need_buf:
+                # geometric growth keeps total copy work O(final size)
+                ac_cap = fit(max(need_buf, 2 * acc[0].shape[0]), cap_round)
+                grow = ac_cap - acc[0].shape[0]
+                acc = (jnp.concatenate(
+                           [acc[0], jnp.full((grow,), a.tile_m, jnp.int32)]),
+                       jnp.concatenate(
+                           [acc[1], jnp.full((grow,), b.tile_n, jnp.int32)]),
+                       jnp.concatenate(
+                           [acc[2], jnp.zeros((grow,), acc[2].dtype)]))
+            acc = _place3(*acc, jnp.int32(nlive),
+                          cp.rows, cp.cols, cp.vals)
+            nlive += pn
     with t_.phase("merge"):
-        out = parts[0] if len(parts) == 1 else fold(parts, None)
+        if acc is None:                       # empty product
+            out = tl.empty(a.tile_m, b.tile_n, fit(1, 128), a.dtype)
+        else:
+            # disjoint columns ⇒ no dedup; ONE sort restores (row, col)
+            # order and pushes the interleaved sentinel padding last
+            out, _ = tl.sort_compress(sr.add, *acc, jnp.int32(nlive),
+                                      nrows=a.tile_m, ncols=b.tile_n,
+                                      cap=fit(nlive, cap_round),
+                                      dedup=False)
         tm.sync(out.rows)
     if out_cap is not None and out.cap != out_cap:
         need = int(np.asarray(out.nnz))
@@ -441,7 +505,8 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                   phase_flop_budget: int = 2 ** 28,
                   prune_hook: Optional[Callable[[DistSpMat], DistSpMat]] = None,
                   out_cap: Optional[int] = None,
-                  cap_round: int = 4096) -> DistSpMat:
+                  cap_round: int = 4096,
+                  cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
     """C = A ⊗ B with B column-split into phases, each multiplied under
     its own flop budget, optionally pruned between phases, then
     concatenated (≅ MemEfficientSpGEMM, ParFriends.h:450-733).
@@ -458,13 +523,18 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     hook that indexes columns by absolute position would see different
     ids. This is the route past the 2^30 single-multiply expansion
     ceiling: per-phase expansions stay small regardless of total FLOPs.
+
+    ``cap_ladder``: pass one `CapLadder` across repeated calls of an
+    iterated pipeline (MCL) so the capacity buckets chosen by the
+    first (largest) call are reused by later, smaller calls — the
+    whole run then compiles its kernels once (VERDICT r4 #1).
     """
     if a.grid.pr == 1 and a.grid.pc == 1:
         _check_product(a, b)
         return _phased_1x1(sr, a, b, phases=phases,
                            phase_flop_budget=phase_flop_budget,
                            prune_hook=prune_hook, out_cap=out_cap,
-                           cap_round=cap_round)
+                           cap_round=cap_round, cap_ladder=cap_ladder)
 
     def mult(bp, p, phases):
         return _planned_summa(sr, a, bp, cap_round,

@@ -30,7 +30,8 @@ def load_native(src: pathlib.Path, configure) -> ctypes.CDLL | None:
             build.mkdir(exist_ok=True)
             tmp = so.with_suffix(f".{os.getpid()}.tmp")
             subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", str(src), "-o", str(tmp)],
+                ["g++", "-O3", "-shared", "-fPIC", "-pthread",
+                 str(src), "-o", str(tmp)],
                 check=True, capture_output=True, timeout=120)
             tmp.replace(so)  # atomic: concurrent builders race safely
         lib = ctypes.CDLL(str(so))

@@ -94,6 +94,52 @@ def test_python_fallback_matches_native(tmp_path, grid, monkeypatch):
     np.testing.assert_allclose(v1, v2)
 
 
+def test_parallel_parse_boundaries(tmp_path, rng):
+    """Byte-range parallel parse == serial parse on a file big enough
+    for many ranges, with varied line lengths (so range boundaries
+    straddle records), interior comment lines, and no trailing
+    newline (the mmap tail path)."""
+    lib = _native.load()
+    assert lib is not None
+    n = 50_000
+    r = rng.integers(0, 999, n) + 1
+    c = rng.integers(0, 999, n) + 1
+    v = rng.random(n) * 10 - 5
+    lines = [f"{ri} {ci} {vi:.{6 + (i % 9)}g}"
+             for i, (ri, ci, vi) in enumerate(zip(r, c, v))]
+    lines.insert(1234, "% interior comment")
+    lines.insert(4321, "   ")                 # blank-ish line
+    body = "\n".join(lines)                   # NO trailing newline
+    p = tmp_path / "big.mtx"
+    p.write_text("%%MatrixMarket matrix coordinate real general\n"
+                 f"1000 1000 {n}\n" + body)
+    import ctypes
+
+    def parse(nt):
+        rows = np.empty(n, np.int32)
+        cols = np.empty(n, np.int32)
+        vals = np.empty(n, np.float64)
+        got = lib.mm_read_body_par(
+            str(p).encode(),
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, nt)
+        assert got == n, f"nthreads={nt}: parsed {got} of {n}"
+        return rows, cols, vals
+
+    r1, c1, v1 = parse(1)
+    np.testing.assert_array_equal(r1, r - 1)
+    np.testing.assert_array_equal(c1, c - 1)
+    np.testing.assert_allclose(v1, np.asarray(
+        [float(x.split()[2]) for x in lines if x.strip() and
+         not x.startswith("%")]))
+    for nt in (2, 4, 13):
+        rn, cn, vn = parse(nt)
+        np.testing.assert_array_equal(rn, r1)
+        np.testing.assert_array_equal(cn, c1)
+        np.testing.assert_array_equal(vn, v1)
+
+
 def test_write_read_roundtrip(tmp_path, rng, grid):
     d = rng.random((13, 17)).astype(np.float32)
     d[rng.random((13, 17)) > 0.3] = 0
