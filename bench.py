@@ -128,6 +128,41 @@ def bench_spgemm(args):
                            "absolutes, are meaningful)"}
 
 
+def bench_bc(args):
+    """One batched-Brandes BC batch at scale 14+ (VERDICT r4 #5's
+    done-criterion): forward+backward SpMM waves with all state
+    device-resident; reports wall time and per-level sync count."""
+    import jax
+    import jax.numpy as jnp
+    from combblas_tpu.ops import generate
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.models import bc as BC
+    from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    grid = ProcGrid.make()
+    n = 1 << args.bc_scale
+    r, c = generate.rmat_edges(jax.random.key(args.seed + 3),
+                               args.bc_scale, args.edgefactor)
+    a = dm.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    jax.block_until_ready(a.rows)
+    af = a.astype(jnp.float32)
+    at = dm.transpose(af)
+    roots = list(range(7, 7 + args.bc_batch))
+    # warm-up (compile), then timed batch
+    BC.bc_batch(af, at, roots)
+    t0 = time.perf_counter()
+    scores = BC.bc_batch(af, at, roots)
+    dt = time.perf_counter() - t0
+    return {"scale": args.bc_scale, "batch": args.bc_batch,
+            "seconds": round(dt, 3),
+            "nonzero_scores": int((scores > 0).sum()),
+            "note": "one batched-Brandes batch (forward+backward SpMM "
+                    "levels, all state device-resident, one scalar "
+                    "sync per forward level)"}
+
+
 def bench_mcl(args):
     """End-to-end MCL on a synthetic clustered graph with per-iteration
     phase timing (≅ MCL.cpp's per-iteration stats)."""
@@ -200,6 +235,11 @@ def main():
     ap.add_argument("--phase-flop-budget", type=int, default=2 ** 26)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--skip-spgemm", action="store_true")
+    ap.add_argument("--with-bc", action="store_true",
+                    help="also time one betweenness-centrality batch "
+                         "(scale --bc-scale, --bc-batch roots)")
+    ap.add_argument("--bc-scale", type=int, default=14)
+    ap.add_argument("--bc-batch", type=int, default=16)
     ap.add_argument("--with-mcl", action="store_true",
                     help="run the MCL end-to-end bench live (adds ~10+ "
                          "min: XLA recompiles per capacity bucket on "
@@ -280,6 +320,16 @@ def main():
             })
         except Exception as e:       # never lose the BFS headline
             extra.append({"metric": "spgemm_bench_error", "error": str(e)})
+    if args.with_bc:
+        try:
+            bc = bench_bc(args)
+            extra.append({
+                "metric": f"bc_scale{bc['scale']}_batch{bc['batch']}_seconds",
+                "value": bc["seconds"], "unit": "s",
+                **{k: bc[k] for k in ("nonzero_scores", "note")},
+            })
+        except Exception as e:
+            extra.append({"metric": "bc_bench_error", "error": str(e)})
     if args.with_mcl:
         try:
             mc = bench_mcl(args)
@@ -294,12 +344,15 @@ def main():
             extra.append({"metric": "mcl_bench_error", "error": str(e)})
     else:
         # embed the newest recorded end-to-end measurement (same
-        # machine) instead of re-running it inside the bench window
+        # machine) instead of re-running it inside the bench window;
+        # newest by mtime, not name (scripts/mcl_bench.py writes
+        # MCL_BENCH_latest.json by default)
         try:
             import glob
             import os
             cands = sorted(glob.glob(os.path.join(os.path.dirname(
-                os.path.abspath(__file__)), "MCL_BENCH_r*.json")))
+                os.path.abspath(__file__)), "MCL_BENCH_*.json")),
+                key=os.path.getmtime)
             with open(cands[-1]) as f:
                 extra.append({**json.load(f), "recorded": True,
                               "recorded_file": os.path.basename(cands[-1])})

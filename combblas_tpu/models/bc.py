@@ -16,6 +16,7 @@ planes (the BFS-DAG stack of BetwCent.cpp:171).
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Optional, Sequence
 
 import jax
@@ -36,16 +37,26 @@ def _to_cmv(y: dn.DistMultiVec, a: dm.DistSpMat) -> dn.DistMultiVec:
 @jax.jit
 def _bc_fwd(y, visited, nsp):
     """One forward-level update on the r-aligned (nb, block, batch)
-    layouts: fresh mask, visited/nsp accumulation, next fringe, and
+    layouts: fresh mask (bit-packed for the level stack — an unpacked
+    bool plane per level is O(n*batch*diameter) HBM, which OOMs on
+    high-diameter graphs), visited/nsp accumulation, next fringe, and
     the termination scalar — all device-side."""
     fresh = (y != 0) & ~visited
     fg = jnp.where(fresh, y, jnp.zeros((), y.dtype))
-    return fresh, visited | fresh, nsp + fg, fg, jnp.any(fresh)
+    return (jnp.packbits(fresh, axis=1), visited | fresh, nsp + fg, fg,
+            jnp.any(fresh))
 
 
 @jax.jit
-def _bc_bwd_pre(wd, delta, inv_nsp):
+def _bc_bwd_pre_packed(wd_packed, delta, inv_nsp):
+    wd = jnp.unpackbits(wd_packed, axis=1,
+                        count=delta.shape[1]).astype(bool)
     return jnp.where(wd, (1.0 + delta) * inv_nsp, 0.0)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def _unpack_level(wd_packed, block):
+    return jnp.unpackbits(wd_packed, axis=1, count=block).astype(bool)
 
 
 @jax.jit
@@ -81,7 +92,7 @@ def bc_batch(a: dm.DistSpMat, at: dm.DistSpMat,
     root_mask = nsp.map(lambda d: d != 0)         # device (root, col) bits
     fringe = nsp
     visited = root_mask.data
-    levels = []                          # per-level device (nb, blk, b)
+    levels = []              # per-level device (nb, blk/8, b) bit-packed
 
     while True:
         y = dn.spmm(S.PLUS_TIMES_F32, at, _to_cmv(fringe, at))
@@ -97,10 +108,11 @@ def bc_batch(a: dm.DistSpMat, at: dm.DistSpMat,
                         1.0 / jnp.maximum(nsp.data, 1e-30), 0.0)
     delta = jnp.zeros_like(nsp.data)
     for d in range(len(levels) - 1, -1, -1):
-        t1 = _bc_bwd_pre(levels[d], delta, inv_nsp)
+        t1 = _bc_bwd_pre_packed(levels[d], delta, inv_nsp)
         t2 = dn.spmm(S.PLUS_TIMES_F32, a,
                      _to_cmv(dataclasses.replace(nsp, data=t1), a))
-        pred = levels[d - 1] if d > 0 else root_mask.data
+        pred = (_unpack_level(levels[d - 1], delta.shape[1]) if d > 0
+                else root_mask.data)
         delta = _bc_bwd_post(delta, pred, nsp.data, t2.data)
 
     # a root's own accumulation row is excluded from its column's tally

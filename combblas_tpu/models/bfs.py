@@ -139,14 +139,6 @@ def _mask_words(masks: jax.Array) -> int:
     return masks.shape[-1]
 
 
-def _tile_mask_tensor(masks: np.ndarray) -> np.ndarray:
-    """Pre-tile a (..., nstages, words) mask tensor to the Pallas
-    operand layout (..., nstages, words/128, 128) when possible."""
-    if masks.shape[-1] % 128 == 0:
-        return masks.reshape(*masks.shape[:-1], -1, 128)
-    return masks
-
-
 @jax.jit
 def _plan_bfs_core(a: dm.DistSpMat) -> BfsPlan:
     pr, pc, cap = a.grid.pr, a.grid.pc, a.cap
@@ -210,7 +202,7 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
         for j in range(pc):
             tiles.append(_cached_route_masks(c2r[i, j], compact))
     npad_r = rt.mask_npad(tiles[0].shape[-1], compact)
-    masks = _tile_mask_tensor(np.stack(tiles).reshape(
+    masks = rt.tile_masks_batched(np.stack(tiles).reshape(
         pr, pc, *tiles[0].shape))
     # device_put straight from numpy: resharding an already-committed
     # array would stage the full mask tensor on one device first — an
@@ -274,7 +266,7 @@ def _plan_parent_extract(a: dm.DistSpMat, plan: BfsPlan, npad: int,
     del occupied
     perm[perm < 0] = free_dst
     del free_dst
-    srt = _tile_mask_tensor(_cached_route_masks(perm, compact))
+    srt = rt.tile_masks_batched(_cached_route_masks(perm, compact))
     nwm = -(-tile_m // 32)
     rnon = np.asarray(rt.pack_bits(jnp.asarray(nonempty.astype(np.int8)),
                                    nwm * 32))
@@ -1196,6 +1188,7 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
                  validate: bool = False, validate_roots: int = 0,
                  alpha: int = 8, route: bool | str = "auto",
                  route_budget_s: float = 900.0, root_windows: int = 8,
+                 mesh_kernel: str = "auto",
                  verbose: bool = False) -> BfsRunStats:
     """End-to-end Graph500 kernel-2 harness: generate R-MAT, build the
     symmetric adjacency matrix, run BFS from random roots, report TEPS
@@ -1259,15 +1252,26 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
 
     # the edge-space bit BFS is the fast path when it applies: routed
     # plan + single tile (symmetric adjacency — Graph500 graphs are),
-    # or routed plan + square mesh (the distributed variant, which
-    # needs no symmetry). NB: kernels take (a, plan, root) as ARGS —
+    # or routed plan + square TPU mesh (the distributed variant, which
+    # needs no symmetry). The mesh criterion is backend-aware — see
+    # "Mesh BFS kernel dispatch (v5e decision memo)" in PARITY.md,
+    # which records the measurements behind it: single-chip bit path
+    # 2.4x faster than the stepper on TPU, but 3-5x SLOWER under
+    # XLA-CPU's emulated word rolls, so CPU meshes (the correctness
+    # rig) default to the stepper. ``mesh_kernel`` overrides for
+    # profiling either path. NB: kernels take (a, plan, root) as ARGS —
     # closing over the committed matrix would inline it as jaxpr
     # constants (per-call re-upload / oversized HLO on remote TPUs).
+    if mesh_kernel not in ("auto", "bits", "stepper"):
+        raise ValueError(f"mesh_kernel must be 'auto', 'bits' or "
+                         f"'stepper', got {mesh_kernel!r}")
+    on_tpu = jax.default_backend() not in ("cpu", "gpu")
     if plan.starts_bits is not None and grid.pr == 1 and grid.pc == 1:
         kernel = lambda a_, p_, r_: bfs_bits(a_, r_, p_)  # noqa: E731
         if verbose:
             print("kernel: edge-space bit BFS", flush=True)
-    elif _bits_mesh_ok(a, plan):
+    elif _bits_mesh_ok(a, plan) and (
+            mesh_kernel == "bits" or (mesh_kernel == "auto" and on_tpu)):
         kernel = lambda a_, p_, r_: bfs_bits_mesh(a_, r_, p_)  # noqa: E731
         if verbose:
             print("kernel: distributed edge-space bit BFS", flush=True)

@@ -290,6 +290,18 @@ def tile_masks(masks: jax.Array) -> jax.Array:
     return masks
 
 
+def tile_masks_batched(masks):
+    """The same Pallas operand-layout pre-tiling for a BATCHED host
+    mask tensor (..., nstages, w) -> (..., nstages, w/128, 128) —
+    used at plan time (numpy, leading grid dims) so per-root
+    traversals never pay the relayout. Keep in lockstep with
+    `tile_masks` above: both encode the one operand-layout
+    convention."""
+    if masks.shape[-1] % 128 == 0:
+        return masks.reshape(*masks.shape[:-1], -1, 128)
+    return masks
+
+
 # --------------------------------------------------------------------------
 # Pallas application: the packed bit-vector stays resident in VMEM for
 # all 2*log2(npad)-1 stages; only the masks stream from HBM (one stage

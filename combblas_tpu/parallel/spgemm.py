@@ -242,11 +242,16 @@ def _bucket_cap(x: int, floor: int) -> int:
 
 
 def _planned_summa(sr: Semiring, a: DistSpMat, b: DistSpMat,
-                   cap_round: int, what: str) -> DistSpMat:
+                   cap_round: int, what: str,
+                   cap_ladder: Optional["CapLadder"] = None) -> DistSpMat:
     """plan + bucket caps (for compile reuse) + saturation guard + summa."""
     fc, oc = plan_spgemm(a, b)
-    fc = _bucket_cap(fc, cap_round)
-    oc = _bucket_cap(oc, cap_round)
+    if cap_ladder is not None:
+        fc = cap_ladder.fit(fc, cap_round)
+        oc = cap_ladder.fit(oc, cap_round)
+    else:
+        fc = _bucket_cap(fc, cap_round)
+        oc = _bucket_cap(oc, cap_round)
     if fc > _SAT:
         raise ValueError(
             f"{what} needs a {fc}-slot expansion (> 2^30); "
@@ -538,7 +543,8 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
 
     def mult(bp, p, phases):
         return _planned_summa(sr, a, bp, cap_round,
-                              f"phase {p}/{phases} of phased SpGEMM")
+                              f"phase {p}/{phases} of phased SpGEMM",
+                              cap_ladder=cap_ladder)
 
     return phase_loop(a, b, mult, phases=phases,
                       phase_flop_budget=phase_flop_budget,
