@@ -352,7 +352,7 @@ def main():
             import os
             cands = sorted(glob.glob(os.path.join(os.path.dirname(
                 os.path.abspath(__file__)), "MCL_BENCH_*.json")),
-                key=os.path.getmtime)
+                key=lambda p: (os.path.getmtime(p), p))
             with open(cands[-1]) as f:
                 extra.append({**json.load(f), "recorded": True,
                               "recorded_file": os.path.basename(cands[-1])})

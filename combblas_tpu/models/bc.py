@@ -54,9 +54,14 @@ def _bc_bwd_pre_packed(wd_packed, delta, inv_nsp):
     return jnp.where(wd, (1.0 + delta) * inv_nsp, 0.0)
 
 
-@partial(jax.jit, static_argnames=("block",))
-def _unpack_level(wd_packed, block):
-    return jnp.unpackbits(wd_packed, axis=1, count=block).astype(bool)
+@jax.jit
+def _bc_bwd_post_packed(delta, pred_packed, nsp, t2):
+    """Post step with the pred mask unpacked INSIDE the same dispatch
+    (a separate unpack call would be one more ~0.3-0.5 s relay round
+    trip per backward level)."""
+    pred = jnp.unpackbits(pred_packed, axis=1,
+                          count=delta.shape[1]).astype(bool)
+    return delta + jnp.where(pred, nsp * t2, jnp.zeros((), t2.dtype))
 
 
 @jax.jit
@@ -111,9 +116,11 @@ def bc_batch(a: dm.DistSpMat, at: dm.DistSpMat,
         t1 = _bc_bwd_pre_packed(levels[d], delta, inv_nsp)
         t2 = dn.spmm(S.PLUS_TIMES_F32, a,
                      _to_cmv(dataclasses.replace(nsp, data=t1), a))
-        pred = (_unpack_level(levels[d - 1], delta.shape[1]) if d > 0
-                else root_mask.data)
-        delta = _bc_bwd_post(delta, pred, nsp.data, t2.data)
+        if d > 0:
+            delta = _bc_bwd_post_packed(delta, levels[d - 1], nsp.data,
+                                        t2.data)
+        else:
+            delta = _bc_bwd_post(delta, root_mask.data, nsp.data, t2.data)
 
     # a root's own accumulation row is excluded from its column's tally
     delta = jnp.where(root_mask.data, 0.0, delta)

@@ -1275,6 +1275,12 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
         kernel = lambda a_, p_, r_: bfs_bits_mesh(a_, r_, p_)  # noqa: E731
         if verbose:
             print("kernel: distributed edge-space bit BFS", flush=True)
+    elif mesh_kernel == "bits":
+        # an explicit override that cannot be honored must not
+        # silently measure the wrong kernel
+        raise ValueError(
+            "mesh_kernel='bits' requires a routed plan on a square "
+            "mesh with square vertex blocks (_bits_mesh_ok)")
     else:
         kernel = lambda a_, p_, r_: bfs(a_, r_, p_, alpha=alpha)  # noqa: E731
 

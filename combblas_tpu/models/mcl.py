@@ -31,9 +31,13 @@ from combblas_tpu.parallel import spgemm as spg
 from combblas_tpu.models import cc as ccmod
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(frozen=True)
 class MclParams:
-    """Clustering knobs (≅ HipMCL's ProcessParam, MCL.cpp:233-296)."""
+    """Clustering knobs (≅ HipMCL's ProcessParam, MCL.cpp:233-296).
+    Frozen/hashable: the whole prune/select/recovery hook is jitted
+    with the params as a static argument (one relay dispatch per
+    expansion window instead of ~10 — each dispatch through a
+    tunneled TPU costs ~0.3-0.5 s)."""
     inflation: float = 2.0          # -I
     prune_threshold: float = 1e-4   # -p  (cutoff below which entries drop)
     select: int = 1100              # -S  (max kept entries per column)
@@ -77,6 +81,7 @@ def _times(v, s):
     return v * s
 
 
+@jax.jit
 def make_col_stochastic(a: dm.DistSpMat) -> dm.DistSpMat:
     """Scale each column to sum 1 (≅ MakeColStochastic, MCL.cpp:390:
     Reduce(Column, plus) + safemultinv + DimApply)."""
@@ -84,19 +89,30 @@ def make_col_stochastic(a: dm.DistSpMat) -> dm.DistSpMat:
     return alg.dim_apply(a, "col", sums.map(_inv_or_zero), _times)
 
 
-def chaos(a: dm.DistSpMat) -> float:
-    """Convergence metric (≅ Chaos, MCL.cpp:408): max over columns of
-    colMax - colSumOfSquares (0 when every column is a single 1).
-    Both column reductions and the final max stay on device; ONE
-    scalar readback per call (a tunneled TPU pays ~100 ms per sync)."""
+@jax.jit
+def _chaos_dev(a: dm.DistSpMat):
     colmax = alg.reduce(S.MAX, a, "col")
     colssq = alg.reduce(S.PLUS, a, "col", map_val=jnp.square)
     d = jnp.where(colmax.data > -jnp.inf, colmax.data - colssq.data, 0.0)
-    return float(np.asarray(jnp.max(d)))
+    return jnp.max(d)
 
 
+def chaos(a: dm.DistSpMat) -> float:
+    """Convergence metric (≅ Chaos, MCL.cpp:408): max over columns of
+    colMax - colSumOfSquares (0 when every column is a single 1). One
+    fused dispatch + ONE scalar readback per call (a tunneled TPU
+    pays ~100 ms per sync and ~0.3-0.5 s per dispatch)."""
+    return float(np.asarray(_chaos_dev(a)))
+
+
+@partial(jax.jit, static_argnames=("power",))
 def inflate(a: dm.DistSpMat, power: float) -> dm.DistSpMat:
-    """Hadamard power + re-normalization (≅ Inflate, MCL.cpp:447)."""
+    """Hadamard power + re-normalization (≅ Inflate, MCL.cpp:447).
+    Jitted with ``power`` static: the round-4 version rebuilt a
+    ``partial(_pow, power=...)`` each call and passed it to the
+    static-fn `alg.apply` — a fresh hash key, hence a full XLA
+    recompile of the apply EVERY iteration (a large slice of the
+    2117 s round-4 MCL wall time)."""
     powed = alg.apply(a, partial(_pow, power=power))
     return make_col_stochastic(powed)
 
@@ -105,6 +121,7 @@ def _pow(v, power):
     return jnp.power(v, power)
 
 
+@partial(jax.jit, static_argnames=("p",))
 def mcl_prune_select_recover(c: dm.DistSpMat, p: MclParams) -> dm.DistSpMat:
     """Per-column prune/select/recovery (≅ MCLPruneRecoverySelect,
     ParFriends.h:186):

@@ -441,9 +441,15 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                          t.vals[None, None], t.nnz[None, None],
                          grid, a.nrows, b.ncols, t.nrows, t.ncols)
 
+    import os
+    import sys
+    import time as _time
+    dbg = os.environ.get("COMBBLAS_TPU_PHASE_DEBUG") == "1"
     acc = None          # (rows, cols, vals) sentinel-padded, unsorted
     nlive = 0           # host-known live prefix of acc
-    for (lo, hi, fc, oc) in windows:
+    for wi, (lo, hi, fc, oc) in enumerate(windows):
+        if dbg:
+            _t = _time.perf_counter()
         with t_.phase("local"):
             cp = tl.spgemm_colwindow(
                 sr, at, bt, jnp.asarray(lo, jnp.int32),
@@ -479,6 +485,10 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             acc = _place3(*acc, jnp.int32(nlive),
                           cp.rows, cp.cols, cp.vals)
             nlive += pn
+        if dbg:
+            print(f"# win {wi}/{len(windows)} [{lo},{hi}) fc={fc} "
+                  f"oc={oc} nnz={pn} {_time.perf_counter() - _t:.2f}s",
+                  file=sys.stderr, flush=True)
     with t_.phase("merge"):
         if acc is None:                       # empty product
             out = tl.empty(a.tile_m, b.tile_n, fit(1, 128), a.dtype)

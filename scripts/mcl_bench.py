@@ -52,6 +52,10 @@ def main():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "MCL_BENCH_latest.json")
     max_iters = int(sys.argv[3]) if len(sys.argv) > 3 else 30
+    # phase flop budget (log2): 26 keeps every expansion window's ESC
+    # buffers ~1.6 GB — the 2^27 default stalled iteration 2 at scale
+    # 16 (the ~134M-slot window kernel wedged the remote compile path)
+    budget = 1 << (int(sys.argv[4]) if len(sys.argv) > 4 else 26)
     n = 1 << scale
     nclust = max(2, n // 64)
 
@@ -67,8 +71,9 @@ def main():
     tm.GLOBAL.counts.clear()
     tm.set_enabled(True)
     t0 = time.perf_counter()
-    labels, ncl, iters = M.mcl(a, M.MclParams(max_iters=max_iters),
-                               verbose=True)
+    labels, ncl, iters = M.mcl(
+        a, M.MclParams(max_iters=max_iters, phase_flop_budget=budget),
+        verbose=True)
     jax.block_until_ready(labels.data)
     dt = time.perf_counter() - t0
     tm.set_enabled(False)
