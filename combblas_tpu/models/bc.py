@@ -58,10 +58,11 @@ def _bc_bwd_pre_packed(wd_packed, delta, inv_nsp):
 def _bc_bwd_post_packed(delta, pred_packed, nsp, t2):
     """Post step with the pred mask unpacked INSIDE the same dispatch
     (a separate unpack call would be one more ~0.3-0.5 s relay round
-    trip per backward level)."""
+    trip per backward level). Delegates to `_bc_bwd_post` so the
+    Brandes tally formula exists once; the nested jit inlines."""
     pred = jnp.unpackbits(pred_packed, axis=1,
                           count=delta.shape[1]).astype(bool)
-    return delta + jnp.where(pred, nsp * t2, jnp.zeros((), t2.dtype))
+    return _bc_bwd_post(delta, pred, nsp, t2)
 
 
 @jax.jit

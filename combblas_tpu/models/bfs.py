@@ -1266,7 +1266,8 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
         raise ValueError(f"mesh_kernel must be 'auto', 'bits' or "
                          f"'stepper', got {mesh_kernel!r}")
     on_tpu = jax.default_backend() not in ("cpu", "gpu")
-    if plan.starts_bits is not None and grid.pr == 1 and grid.pc == 1:
+    if (plan.starts_bits is not None and grid.pr == 1 and grid.pc == 1
+            and mesh_kernel != "stepper"):
         kernel = lambda a_, p_, r_: bfs_bits(a_, r_, p_)  # noqa: E731
         if verbose:
             print("kernel: edge-space bit BFS", flush=True)
