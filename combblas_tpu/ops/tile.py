@@ -677,6 +677,54 @@ def spgemm(sr: Semiring, a: Tile, b: Tile, *, flops_cap: int, out_cap: int,
     return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup)
 
 
+@partial(jax.jit, static_argnames=("sr", "eblk", "flops_cap", "out_cap",
+                                   "dedup"))
+def spgemm_rowblock(sr: Semiring, a: Tile, b: Tile, bptr: Array, elo: Array,
+                    ehi: Array, *, eblk: int, flops_cap: int, out_cap: int,
+                    dedup: bool = True) -> Tile:
+    """c-rows block: A's entry range [elo, ehi) ⊗ b, with ``bptr`` =
+    row_starts(b) HOISTED out of the loop (window-independent).
+    ``eblk`` is the static slice width (>= ehi-elo for every block in
+    a plan, so all blocks share one compiled kernel); entries in
+    [ehi, elo+eblk) are masked out — without the ``ehi`` bound a
+    bucketed eblk would over-read into the next block and double-count
+    its products.
+
+    The streaming dual of `spgemm_colwindow`: C is produced in
+    row-aligned A-entry blocks instead of column windows. Per-block
+    cost is O(eblk + flops_cap) — no O(A.cap)/O(B.cap) term — where
+    the column-window kernel recomputes per-row window counts over ALL
+    of B and gathers counts for ALL of A per call: at scale 22 that
+    O(windows x cap) overhead alone is ~500B ops (measured ~20
+    s/window; see PARITY.md "Scale-22 A*A: measured status").
+
+    Caller contract (scripts/spgemm_stream.py rows mode plans this):
+    cuts must lie on ROW boundaries of A (a C row's products then live
+    in exactly one block, so per-block dedup is globally exact and
+    block nnz sums to C's nnz), and A's capacity must be >=
+    max(elo) + eblk so the dynamic_slice never clamps.
+    """
+    assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
+    assert bptr.shape == (b.nrows + 1,), (
+        f"bptr shape {bptr.shape} != (b.nrows+1,) = {(b.nrows + 1,)}: "
+        "pass row_starts(b) for THIS b")
+    _flops_cap_guard(flops_cap)
+    elo = jnp.asarray(elo, jnp.int32)
+    ehi = jnp.asarray(ehi, jnp.int32)
+    ar = lax.dynamic_slice(a.rows, (elo,), (eblk,))
+    ac = lax.dynamic_slice(a.cols, (elo,), (eblk,))
+    av = lax.dynamic_slice(a.vals, (elo,), (eblk,))
+    idx = jnp.arange(eblk, dtype=jnp.int32) + elo
+    valid = (idx < a.nnz) & (idx < ehi)
+    blk = Tile(jnp.where(valid, ar, a.nrows),
+               jnp.where(valid, ac, a.ncols), av,
+               jnp.sum(valid).astype(jnp.int32), a.nrows, a.ncols)
+    acol = jnp.clip(blk.cols, 0, a.ncols - 1)
+    per = jnp.where(valid, bptr[acol + 1] - bptr[acol], 0)
+    base = bptr[acol]
+    return _esc2_finish(sr, blk, b, per, base, flops_cap, out_cap, dedup)
+
+
 @partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup"))
 def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
                      *, flops_cap: int, out_cap: int,

@@ -1,15 +1,24 @@
 #!/usr/bin/env python
 """Streamed R-MAT A*A at scales whose full C exceeds HBM (scale 18+
-on one chip): each balanced-flop column window is multiplied, its nnz
-counted, and the block DISCARDED — the BlockSpGEMM pattern
-(reference BlockSpGEMM.h:50-75: getNextBlock bounds memory for huge
-outputs). The input matrix itself is built with the chunked
-DistEdgeList-style generator (no global edge array).
+on one chip): C is produced block by block, each block's nnz counted
+and the block DISCARDED — the BlockSpGEMM pattern (reference
+BlockSpGEMM.h:50-75: getNextBlock bounds memory for huge outputs). The
+input matrix itself is built with the chunked DistEdgeList-style
+generator (no global edge array).
+
+Two streaming orders:
+  rows (default) — row-aligned A-entry blocks (`tile.spgemm_rowblock`):
+      per-block cost O(block + flops); B's row pointers hoisted out of
+      the loop. The scalable order.
+  cols — balanced-flop column windows (`tile.spgemm_colwindow`): pays
+      O(A.cap + B.cap) of window counting per window, which turns
+      quadratic at scale 22 (3,762 windows; measured ~20 s/window —
+      PARITY.md "Scale-22 A*A: measured status"). Kept for comparison.
 
 Prints one JSON line: {"scale": S, "c_nnz": N, "seconds": T,
-"nnz_per_sec_per_chip": R, "phases": P}.
+"nnz_per_sec_per_chip": R, "blocks": P, "mode": M}.
 
-Usage: python scripts/spgemm_stream.py [scale] [edgefactor] [budget_log2]
+Usage: spgemm_stream.py [scale] [edgefactor] [budget_log2] [rows|cols]
 """
 import json
 import os
@@ -29,10 +38,63 @@ from combblas_tpu.parallel import spgemm as spg
 from combblas_tpu.parallel.grid import ProcGrid
 
 
+def plan_rowblocks(at: tl.Tile, budget: int):
+    """Row-aligned A-entry block plan for A*A: [(elo, flops)] cuts at
+    row boundaries by cumulative flops, plus the shared static caps.
+    Host traffic is two O(nrows) readbacks (row flops + row starts) —
+    NOT the O(cap) entry arrays."""
+    pe = tl.spgemm_flops_per_entry(at, at)              # (cap,) device
+    rows = jnp.clip(at.rows, 0, at.nrows)
+    # accumulate per-row flops in two int32 halves (x64 is disabled on
+    # device) and recombine in int64 on host: a single-half int32
+    # scatter-add can wrap PAST 2^32 back to positive on extreme hub
+    # rows, silently corrupting the plan and the published metric
+    pe_lo = pe & 0xFFFF
+    pe_hi = pe >> 16
+    lo_d = jnp.zeros((at.nrows + 1,), jnp.int32).at[rows].add(
+        pe_lo, mode="drop")[:at.nrows]
+    hi_d = jnp.zeros((at.nrows + 1,), jnp.int32).at[rows].add(
+        pe_hi, mode="drop")[:at.nrows]
+    # halves stay exact while each stays under 2^31: lo sums <= nnz_row
+    # * 2^16, hi sums <= nnz_row * (max_pe >> 16) — fine to ~2^14-entry
+    # rows with 2^30-flop entries; verify non-negativity anyway
+    lo = np.asarray(lo_d).astype(np.int64)
+    hi = np.asarray(hi_d).astype(np.int64)
+    if (lo < 0).any() or (hi < 0).any():
+        raise ValueError("row-flop half-accumulators overflowed int32; "
+                         "split rows or widen the accumulation")
+    rowfl = lo + (hi << 16)
+    aptr = np.asarray(tl.row_starts(at))                # (nrows+1,)
+    cum = np.cumsum(rowfl)
+    total = int(cum[-1]) if len(cum) else 0
+    nblocks = max(1, -(-total // budget))
+    rcuts = np.searchsorted(cum, total * np.arange(1, nblocks) // nblocks,
+                            side="left") + 1
+    rcuts = np.unique(np.concatenate([[0], rcuts, [at.nrows]]))
+    elos = aptr[rcuts].astype(np.int64)
+    blocks = []
+    max_f = max_e = 1
+    for lo_r, hi_r, lo_e, hi_e in zip(rcuts[:-1], rcuts[1:],
+                                      elos[:-1], elos[1:]):
+        if hi_e <= lo_e:
+            continue
+        f = int(cum[hi_r - 1] - (cum[lo_r - 1] if lo_r else 0))
+        if f > 2 ** 30 - 1:
+            raise ValueError(
+                f"rows [{lo_r},{hi_r}) need {f} products > 2^30-1: a "
+                "single row exceeds the expansion ceiling")
+        blocks.append((int(lo_e), int(hi_e), f))
+        max_f = max(max_f, f)
+        max_e = max(max_e, int(hi_e - lo_e))
+    from combblas_tpu.parallel.spgemm import _bucket_fine
+    return blocks, _bucket_fine(max_e, 4096), _bucket_fine(max_f, 4096)
+
+
 def main():
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else 18
     ef = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     budget = 1 << (int(sys.argv[3]) if len(sys.argv) > 3 else 26)
+    mode = sys.argv[4] if len(sys.argv) > 4 else "rows"
 
     grid = ProcGrid.make(1, 1, jax.devices()[:1])
     t0 = time.perf_counter()
@@ -47,60 +109,75 @@ def main():
     jax.block_until_ready(a.rows)
     print(f"# build: {time.perf_counter() - t0:.1f}s nnz={a.getnnz()} "
           f"cap={a.cap}", file=sys.stderr, flush=True)
-
-    windows = spg.plan_colwindows(a, a, phase_flop_budget=budget)
     at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
                  a.tile_m, a.tile_n)
-    # warm-up: compile the shared kernel on the first window's buckets
-    lo, hi, fc, oc = windows[0]
-    cp = tl.spgemm_colwindow(S.PLUS_TIMES_F32, at, at,
-                             jnp.int32(lo), jnp.int32(hi),
-                             flops_cap=fc, out_cap=oc)
-    int(np.asarray(cp.nnz))
 
-    # dispatch windows back-to-back with a DEVICE-side nnz accumulator
-    # and sync only every `sync_every` windows: a per-window scalar
-    # readback serializes the stream against the relay round trip
-    # (measured 26 s/window wall at scale 22 vs ~seconds of device
-    # work), while batched dispatches pipeline on the chip
-    # 10 windows x <=2^27 nnz each stays under int32 (x64 is disabled);
-    # the accumulator resets after every readback and the running total
-    # lives in a python int
+    if mode == "rows":
+        blocks, eblk, fc = plan_rowblocks(at, budget)
+        # the dynamic_slice contract: A capacity >= max(elo) + eblk
+        need = max(lo for lo, _, _ in blocks) + eblk
+        if need > at.cap:
+            at = at.with_capacity(need)
+        bptr = tl.row_starts(at)           # hoisted, window-independent
+        oc = fc
+        print(f"# rows plan: {len(blocks)} blocks eblk={eblk} fc={fc}",
+              file=sys.stderr, flush=True)
+
+        def run_block(i):
+            lo, hi, _ = blocks[i]
+            return tl.spgemm_rowblock(
+                S.PLUS_TIMES_F32, at, at, bptr, jnp.int32(lo),
+                jnp.int32(hi), eblk=eblk, flops_cap=fc, out_cap=oc)
+        nblocks = len(blocks)
+        caps = [oc] * nblocks
+    else:
+        windows = spg.plan_colwindows(a, a, phase_flop_budget=budget)
+
+        def run_block(i):
+            lo, hi, fc, oc = windows[i]
+            return tl.spgemm_colwindow(
+                S.PLUS_TIMES_F32, at, at, jnp.int32(lo), jnp.int32(hi),
+                flops_cap=fc, out_cap=oc)
+        nblocks = len(windows)
+        caps = [w[3] for w in windows]
+
+    # warm-up: compile the shared kernel
+    int(np.asarray(run_block(0).nnz))
+
+    # dispatch blocks back-to-back with a DEVICE-side nnz accumulator
+    # and sync only every `sync_every` blocks: a per-block scalar
+    # readback serializes the stream against the relay round trip,
+    # while batched dispatches pipeline on the chip. Sync early before
+    # the int32 accumulator could wrap (x64 is disabled; a hub block's
+    # cap can reach ~2^30) — overflow would corrupt the metric.
     sync_every = 10
     t0 = time.perf_counter()
     acc = jnp.zeros((), jnp.int32)
     c_nnz = 0
-    since_sync = 0      # worst-case nnz in the accumulator (window caps)
+    since_sync = 0
     nsince = 0
-    for wi, (lo, hi, fc, oc) in enumerate(windows):
-        cp = tl.spgemm_colwindow(S.PLUS_TIMES_F32, at, at,
-                                 jnp.int32(lo), jnp.int32(hi),
-                                 flops_cap=fc, out_cap=oc)
+    for wi in range(nblocks):
+        cp = run_block(wi)
         acc = acc + cp.nnz
         del cp                             # the streaming point: drop C
-        since_sync += oc
+        since_sync += caps[wi]
         nsince += 1
-        # sync on the batch boundary AND whenever the accumulator's
-        # worst case (sum of window out caps — a single hub window can
-        # carry up to ~2^30, plan_colwindows does not split columns)
-        # nears int32 range; x64 is disabled, so overflow would wrap
-        # silently and corrupt the published metric
-        nxt_oc = windows[wi + 1][3] if wi + 1 < len(windows) else 0
-        if (nsince >= sync_every or wi + 1 == len(windows)
-                or since_sync + nxt_oc > 2 ** 31 - 1):
+        nxt = caps[wi + 1] if wi + 1 < nblocks else 0
+        if (nsince >= sync_every or wi + 1 == nblocks
+                or since_sync + nxt > 2 ** 31 - 1):
             c_nnz += int(np.asarray(acc))  # barrier: honest wall timing
             acc = jnp.zeros((), jnp.int32)
             since_sync = 0
             nsince = 0
             el = time.perf_counter() - t0
-            if (wi + 1) % 50 < sync_every or wi + 1 == len(windows):
-                print(f"# win {wi + 1}/{len(windows)} nnz={c_nnz} "
-                      f"{el:.0f}s eta={el / (wi + 1) * len(windows):.0f}s",
+            if (wi + 1) % 50 < sync_every or wi + 1 == nblocks:
+                print(f"# blk {wi + 1}/{nblocks} nnz={c_nnz} "
+                      f"{el:.0f}s eta={el / (wi + 1) * nblocks:.0f}s",
                       file=sys.stderr, flush=True)
     dt = time.perf_counter() - t0
     print(json.dumps({
         "scale": scale, "edgefactor": ef, "c_nnz": c_nnz,
-        "seconds": round(dt, 3), "phases": len(windows),
+        "seconds": round(dt, 3), "blocks": nblocks, "mode": mode,
         "nnz_per_sec_per_chip": round(c_nnz / dt / len(jax.devices()), 1),
     }))
 

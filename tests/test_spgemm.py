@@ -163,6 +163,41 @@ class TestPhased1x1:
         with pytest.raises(ValueError, match="out_cap"):
             SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2, out_cap=2)
 
+    def test_rowblock_kernel(self, rng):
+        """Row-aligned A-entry blocks partition C by rows: the blocks
+        sum to the full product, and the RAW per-block nnz (what the
+        streaming driver accumulates) sums to the full product's nnz —
+        the ehi bound keeps the bucketed eblk over-read from
+        double-counting the next block's entries."""
+        from combblas_tpu.ops import tile as tl
+        import jax.numpy as jnp
+        da = random_sparse(rng, 24, 24, 0.4)
+        db = random_sparse(rng, 24, 24, 0.5)
+        at = tl.from_dense(jnp.asarray(da), 0.0, 512)
+        bt = tl.from_dense(jnp.asarray(db), 0.0, 512)
+        bptr = tl.row_starts(bt)
+        aptr = np.asarray(tl.row_starts(at))
+        full = np.zeros((24, 24), np.float32)
+        nnz_sum = 0
+        eblk = 128                 # bucketed: larger than every block
+        # the kernel contract: A capacity >= max(elo) + eblk, else the
+        # dynamic_slice clamps and reads the wrong entries
+        at = at.with_capacity(int(aptr[-1]) + eblk)
+        for rcut_lo, rcut_hi in ((0, 7), (7, 8), (8, 20), (20, 24)):
+            lo, hi = int(aptr[rcut_lo]), int(aptr[rcut_hi])
+            c = tl.spgemm_rowblock(
+                S.PLUS_TIMES_F32, at, bt, bptr, jnp.int32(lo),
+                jnp.int32(hi), eblk=eblk, flops_cap=4096, out_cap=1024)
+            cd = np.asarray(tl.to_dense(c, jnp.float32(0.0)))
+            # rows outside the block must be untouched
+            assert (cd[:rcut_lo] == 0).all() and (cd[rcut_hi:] == 0).all()
+            full += cd
+            nnz_sum += int(np.asarray(c.nnz))
+        np.testing.assert_allclose(full, da @ db, rtol=1e-5)
+        cref = tl.spgemm(S.PLUS_TIMES_F32, at, bt, flops_cap=8192,
+                         out_cap=1024)
+        assert nnz_sum == int(np.asarray(cref.nnz))
+
     def test_colwindow_kernel(self, rng):
         from combblas_tpu.ops import tile as tl
         import jax.numpy as jnp
