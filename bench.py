@@ -46,12 +46,14 @@ def bench_bfs(args):
 
 def bench_spgemm(args):
     """R-MAT scale-S A*A via phased SUMMA; nnz(C)/sec/chip. Also
-    reports the phase split (plan/local/merge — utils.timing GLOBAL,
-    stamped by the phased driver) and a phase-taxonomy SpMSpV probe
-    (fan_out/local/fan_in/merge, ≅ CombBLAS.h:78-100 TIMING)."""
+    reports the obs span breakdown (plan/local/place/sort + the
+    explicit unaccounted residual — see combblas_tpu/obs) and a
+    phase-taxonomy SpMSpV probe (fan_out/local/fan_in/merge,
+    ≅ CombBLAS.h:78-100 TIMING)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from combblas_tpu import obs
     from combblas_tpu.ops import generate
     from combblas_tpu.ops import semiring as S
     from combblas_tpu.parallel import distmat as dm
@@ -81,15 +83,17 @@ def bench_spgemm(args):
     dt = time.perf_counter() - t0
     nnz = cm.getnnz()
     del cm
-    # separate instrumented run for the phase split (syncs ON)
-    tm.GLOBAL.totals.clear()
-    tm.GLOBAL.counts.clear()
-    tm.set_enabled(True)
+    # separate instrumented run for the span breakdown (syncs ON)
+    obs.reset()
+    obs.REGISTRY.reset()
+    obs.set_enabled(True)
     cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
                            phase_flop_budget=args.phase_flop_budget)
     cm.vals.block_until_ready()
-    tm.set_enabled(False)
-    spgemm_phases = tm.GLOBAL.report()
+    obs.set_enabled(False)
+    breakdown = obs.export.phase_breakdown()
+    spgemm_spans = obs.export.report()
+    spgemm_metrics = obs.REGISTRY.snapshot()
     del cm
 
     # SpMSpV phase probe (untimed vs the metric; ~5% random fringe);
@@ -120,7 +124,11 @@ def bench_spgemm(args):
 
     return {"scale": args.spgemm_scale, "c_nnz": nnz, "seconds": dt,
             "nnz_per_sec_per_chip": nnz / dt / max(1, len(jax.devices())),
-            "phases": spgemm_phases, "spmsv_phases": spmsv_phases,
+            "phase_breakdown": {k: round(v, 4)
+                                for k, v in breakdown.items()},
+            "unaccounted_s": round(breakdown["unaccounted"], 4),
+            "spans": spgemm_spans, "metrics": spgemm_metrics,
+            "spmsv_phases": spmsv_phases,
             "phases_note": "phase attribution requires a device sync "
                            "per phase; on a tunneled TPU each sync "
                            "includes the ~100ms relay round trip, so "
@@ -164,16 +172,18 @@ def bench_bc(args):
 
 
 def bench_mcl(args):
-    """End-to-end MCL on a synthetic clustered graph with per-iteration
-    phase timing (≅ MCL.cpp's per-iteration stats)."""
+    """End-to-end MCL on a synthetic clustered graph with the obs span
+    breakdown (≅ MCL.cpp's per-iteration stats): the JSON carries
+    phase_breakdown + unaccounted_s so expansion overhead is never
+    invisible again (round-5's 63% mystery)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from combblas_tpu import obs
     from combblas_tpu.ops import semiring as S
     from combblas_tpu.models import mcl as M
     from combblas_tpu.parallel import distmat as dm
     from combblas_tpu.parallel.grid import ProcGrid
-    from combblas_tpu.utils import timing as tm
 
     grid = ProcGrid.make()
     n = 1 << args.mcl_scale
@@ -195,19 +205,24 @@ def bench_mcl(args):
     a = dm.from_global_coo(S.PLUS, grid, jnp.asarray(r), jnp.asarray(c),
                            jnp.ones(len(r), jnp.float32), n, n)
     jax.block_until_ready(a.rows)
-    tm.GLOBAL.totals.clear()
-    tm.GLOBAL.counts.clear()
-    tm.set_enabled(True)
+    obs.reset()
+    obs.REGISTRY.reset()
+    obs.set_enabled(True)
     t0 = time.perf_counter()
     labels, nclusters, iters = M.mcl(
         a, M.MclParams(max_iters=args.mcl_max_iters))
     jax.block_until_ready(labels.data)
     dt = time.perf_counter() - t0
-    tm.set_enabled(False)
+    obs.set_enabled(False)
+    breakdown = obs.export.phase_breakdown()
     return {"scale": args.mcl_scale, "n": n, "nnz": a.getnnz(),
             "planted_clusters": nclust, "found_clusters": nclusters,
             "iterations": iters, "seconds": round(dt, 3),
-            "phases": tm.GLOBAL.report()}
+            "phase_breakdown": {k: round(v, 4)
+                                for k, v in breakdown.items()},
+            "unaccounted_s": round(breakdown["unaccounted"], 4),
+            "spans": obs.export.report(),
+            "metrics": obs.REGISTRY.snapshot()}
 
 
 def main():
@@ -311,7 +326,10 @@ def main():
                 "unit": "nnz/s/chip",
                 "c_nnz": sp["c_nnz"],
                 "seconds": round(sp["seconds"], 3),
-                "phases": sp["phases"],
+                "phase_breakdown": sp["phase_breakdown"],
+                "unaccounted_s": sp["unaccounted_s"],
+                "spans": sp["spans"],
+                "metrics": sp["metrics"],
                 "spmsv_phases": sp["spmsv_phases"],
                 "note": f"largest single-chip scale whose full C fits "
                         f"HBM is {sp['scale']} (baseline metric names "
@@ -338,7 +356,8 @@ def main():
                 "value": mc["seconds"], "unit": "s",
                 **{k: mc[k] for k in ("n", "nnz", "planted_clusters",
                                       "found_clusters", "iterations",
-                                      "phases")},
+                                      "phase_breakdown", "unaccounted_s",
+                                      "spans", "metrics")},
             })
         except Exception as e:
             extra.append({"metric": "mcl_bench_error", "error": str(e)})

@@ -29,6 +29,10 @@ Design (TPU-first, not a port):
     needs only axis-local collectives and no dynamic shapes.
 """
 
+from combblas_tpu.utils import compat as _compat  # noqa: F401  (installs
+#                               jax.shard_map / lax.pvary shims on old jax
+#                               BEFORE any sharded module is imported)
+
 from combblas_tpu.ops import semiring, tile, generate
 from combblas_tpu.ops.semiring import (
     Monoid, Semiring,

@@ -238,11 +238,15 @@ long long mm_read_body_par(const char* path, int* rows, int* cols,
         // fine (the map extends to fsize and lines never cross it).
         // A final line with no newline could run off the map when
         // fsize is page-aligned — bounce it through a local buffer.
+        // A record line that doesn't fit the buffer cannot be parsed
+        // faithfully: flag a parse error, never truncate silently
+        // (truncation could drop the value field and read "1 2 3.5e8"
+        // as "1 2 3.5" with no diagnostic).
         char tail[4096];
         char* q = base + p;
         if (!nl) {
           size_t len = fsize - p;
-          if (len >= sizeof tail) len = sizeof tail - 1;
+          if (len >= sizeof tail) { errs[t] = 1; return; }
           memcpy(tail, base + p, len);
           tail[len] = '\0';
           q = tail;

@@ -84,15 +84,19 @@ def read_mm_coo(path, nthreads: Optional[int] = None,
     recipe, SpParMat.cpp:3922 + check_newline SpParHelper.h:110, with
     host threads in the role of ranks): the file is mmap'd, split at
     line boundaries, counted then parsed in place — no per-line copy.
-    ``nthreads`` defaults to the host's CPU count (1 file-size-scaled
-    range per thread)."""
+    ``nthreads`` defaults to ``min(16, os.cpu_count())`` — capped at 16
+    because byte-range splitting saturates well before that — and must
+    be >= 1 when given explicitly."""
     path = str(path)
+    if nthreads is not None and nthreads < 1:
+        raise ValueError(f"nthreads must be >= 1, got {nthreads}")
     h = read_mm_header(path)
     lib = _native.load()
     if lib is not None:
         import ctypes
         import os
-        nt = nthreads or min(16, os.cpu_count() or 1)
+        nt = nthreads if nthreads is not None \
+            else min(16, os.cpu_count() or 1)
         rows = np.empty(h.nnz, np.int32)
         cols = np.empty(h.nnz, np.int32)
         vals = np.empty(h.nnz, np.float64)

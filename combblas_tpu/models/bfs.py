@@ -43,6 +43,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from combblas_tpu import obs
 from combblas_tpu.ops import bitseg as bs
 from combblas_tpu.ops import generate
 from combblas_tpu.ops import route as rt
@@ -1202,21 +1203,25 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     key = jax.random.key(seed)
     kgen, _ = jax.random.split(key)   # second stream kept for seed compat
     n = 1 << scale
-    r, c = generate.rmat_edges(kgen, scale, edgefactor)
-    r, c = generate.symmetrize(r, c)
+    with obs.span("g500_generate", category="device_execute"):
+        r, c = generate.rmat_edges(kgen, scale, edgefactor)
+        r, c = generate.symmetrize(r, c)
+        obs.sync(r)
     # initial cap is a guess from the average tile; from_global_coo
     # detects overflow against the true per-tile counts and re-plans
     # with an exact cap (no silent edge dropping under R-MAT skew)
-    a = dm.from_global_coo(S.LOR, grid, r, c,
-                           jnp.ones_like(r, jnp.bool_), n, n,
-                           cap=int(cap_slack * (r.shape[0] //
-                                                (grid.pr * grid.pc))))
-    jax.block_until_ready(a.rows)
+    with obs.span("g500_build", category="device_execute"):
+        a = dm.from_global_coo(S.LOR, grid, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n,
+                               cap=int(cap_slack * (r.shape[0] //
+                                                    (grid.pr * grid.pc))))
+        jax.block_until_ready(a.rows)
     if verbose:
         a.print_info("A")
     t_plan = time.perf_counter()
-    plan = plan_bfs(a, route=route, route_budget_s=route_budget_s)
-    jax.block_until_ready(plan.crows)
+    with obs.span("g500_plan", category="host_compute"):
+        plan = plan_bfs(a, route=route, route_budget_s=route_budget_s)
+        jax.block_until_ready(plan.crows)
     if verbose:
         routed = plan.route_masks is not None
         print(f"plan: {time.perf_counter() - t_plan:.1f}s "
@@ -1299,7 +1304,8 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
         return parents, jnp.stack([visited_d, nedges_d])
 
     # warm-up compile (not timed, like the reference's untimed iteration 0)
-    _ = np.asarray(run_with_stats(a, plan, deg, jnp.int32(roots[0]))[1])
+    with obs.span("g500_warmup", category="compile"):
+        _ = np.asarray(run_with_stats(a, plan, deg, jnp.int32(roots[0]))[1])
 
     # Windowed per-root timing. A tunneled TPU pays a ~85-120 ms relay
     # round trip on every synchronous stats readback; timing
@@ -1332,17 +1338,22 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     vparents: dict = {}
     nwin = max(1, min(root_windows, len(roots)))
     windows = np.array_split(np.arange(len(roots)), nwin)
-    for w in windows:
+    for wi, w in enumerate(windows):
         t0 = time.perf_counter()   # chip is idle (previous batch drained)
-        for ri in w:
-            dispatch(int(ri), roots[int(ri)])
-        per_root: list = []
-        while queue:
-            ri, kp, vn = queue.pop(0)
-            vnv = np.asarray(vn)                    # waits for arrival
-            per_root.append((ri, int(vnv[0]), int(vnv[1])))
-            if kp is not None:
-                vparents[ri] = kp
+        # spans only bracket perf_counter calls — the timed window
+        # gains no syncs and no measurable overhead from them
+        with obs.span("bfs_window", size=len(w), window=wi):
+            with obs.span("dispatch", category="dispatch"):
+                for ri in w:
+                    dispatch(int(ri), roots[int(ri)])
+            per_root: list = []
+            with obs.span("drain", category="host_readback"):
+                while queue:
+                    ri, kp, vn = queue.pop(0)
+                    vnv = np.asarray(vn)            # waits for arrival
+                    per_root.append((ri, int(vnv[0]), int(vnv[1])))
+                    if kp is not None:
+                        vparents[ri] = kp
         t_win = time.perf_counter() - t0
         stats.window_times.append(t_win)
         stats.window_sizes.append(len(w))

@@ -24,6 +24,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from combblas_tpu import obs
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops import semiring as S
 from combblas_tpu.parallel import distmat as dm
@@ -362,4 +363,10 @@ def label_cc(labels: dvec.DistVec) -> tuple[dvec.DistVec, int]:
 def connected_components(a: dm.DistSpMat) -> tuple[dvec.DistVec, int]:
     """FastSV + contiguous relabel: (labels, #components)
     (≅ FastSV.cpp main flow)."""
-    return label_cc(fastsv(a))
+    with obs.span("cc_fastsv", category="device_execute"):
+        labels = fastsv(a)
+        obs.sync(labels.data)
+    # label_cc fetches the whole label vector to host (np.unique there
+    # is host_compute, but the fetch dominates at scale)
+    with obs.span("cc_relabel", category="host_readback"):
+        return label_cc(labels)

@@ -23,12 +23,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from combblas_tpu import obs
+from combblas_tpu.obs import metrics as obm
 from combblas_tpu.ops import semiring as S
 from combblas_tpu.parallel import algebra as alg
 from combblas_tpu.parallel import distmat as dm
 from combblas_tpu.parallel import distvec as dv
 from combblas_tpu.parallel import spgemm as spg
 from combblas_tpu.models import cc as ccmod
+
+_M_ITERS = obm.counter("mcl.iterations", "completed MCL iterations")
+_M_CHAOS = obm.gauge("mcl.chaos", "chaos convergence metric per iteration")
+_M_NNZ = obm.gauge("mcl.nnz", "iterated matrix nnz per iteration")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,15 +189,25 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     """
     if a.nrows != a.ncols:
         raise ValueError("mcl needs a square adjacency matrix")
-    a = a.astype(jnp.float32)
-    a = alg.add_loops(a, 1.0)
-    a = make_col_stochastic(a)
+    with obs.span("mcl"):
+        return _mcl_instrumented(a, params, verbose)
+
+
+def _mcl_instrumented(a, params, verbose):
+    # span taxonomy per iteration (≅ MCL.cpp's printed per-iteration
+    # stats): `mcl_expand` is structural — its children are the phased
+    # SpGEMM driver's plan/window/sort spans plus the cap-pin readback
+    # — so the expansion's dispatch/readback glue (the round-5 63%
+    # mystery) shows up as named categories + an explicit residual
+    with obs.span("mcl_setup", category="device_execute"):
+        a = a.astype(jnp.float32)
+        a = alg.add_loops(a, 1.0)
+        a = make_col_stochastic(a)
+        obs.sync(a.vals)
     ch = float("inf")
     hook = partial(mcl_prune_select_recover, p=params)
     it = 0
     nproc = a.grid.pr * a.grid.pc
-    from combblas_tpu.utils import timing as tm
-    t_ = tm.GLOBAL
     cap_pin = None
     # ONE capacity ladder for the whole run: iteration 1 (the largest —
     # prune shrinks nnz monotonically) mints the rungs; iterations 2..N
@@ -199,10 +215,7 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     # round-4 run spent ~90% of 2117 s in per-iteration recompiles)
     ladder = spg.CapLadder()
     while ch > params.chaos_eps and it < params.max_iters:
-        # phase taxonomy stamped per iteration (≅ MCL.cpp's printed
-        # per-iteration stats; expansion's internal plan/local/prune/
-        # merge phases are stamped by the phased-SpGEMM driver)
-        with t_.phase("mcl_expand"):
+        with obs.span("mcl_expand", it=it):
             a = spg.spgemm_phased(
                 S.PLUS_TIMES_F32, a, a, phases=params.phases,
                 phase_flop_budget=params.effective_flop_budget(nproc),
@@ -211,20 +224,30 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
                 # one host readback per iteration; the first (largest)
                 # iteration usually sets the bucket — MCL's nnz shrinks
                 # after pruning — but a later growth simply re-pins
-                mx = int(np.asarray(a.nnz).max())
+                with obs.span("cap_readback", category="host_readback"):
+                    mx = int(np.asarray(a.nnz).max())
                 if cap_pin is None or mx > cap_pin:
                     cap_pin = -(-(mx * 5 // 4) // 128) * 128
-                a = dm.with_capacity(a, cap_pin)
-            tm.sync(a.vals)
-        with t_.phase("mcl_inflate"):
+                with obs.span("repin", category="device_execute"):
+                    a = dm.with_capacity(a, cap_pin)
+                    obs.sync(a.vals)
+                _M_NNZ.set(mx)
+            else:
+                with obs.span("drain", category="device_execute"):
+                    obs.sync(a.vals)
+        with obs.span("mcl_inflate", category="device_execute", it=it):
             a = inflate(a, params.inflation)
-            tm.sync(a.vals)
-        with t_.phase("mcl_chaos"):
+            obs.sync(a.vals)
+        with obs.span("mcl_chaos", category="host_readback", it=it):
             ch = chaos(a)
         it += 1
+        _M_ITERS.inc()
+        _M_CHAOS.set(ch)
         if verbose:
             print(f"mcl iter {it}: chaos {ch:.6f}, nnz {a.getnnz()}")
-    labels, nclusters = interpret(a)
+    with obs.span("mcl_interpret", category="device_execute"):
+        labels, nclusters = interpret(a)
+        obs.sync(labels.data)
     return labels, nclusters, it
 
 

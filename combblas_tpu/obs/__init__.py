@@ -1,0 +1,38 @@
+"""Observability: span tracing, metrics, and exporters.
+
+The round-5 verdict found ~63% of MCL's expansion wall time was
+dispatch/readback overhead invisible to the four fixed `utils.timing`
+accumulators. This subsystem supersedes them:
+
+* `obs.trace` — nested, named, CATEGORIZED wall-clock spans with an
+  explicit `unaccounted` residual, so a region's clock always adds up;
+* `obs.metrics` — labeled counters/gauges/histograms (nnz, flops,
+  bytes read back, compile-cache hits, phase counts);
+* `obs.export` — report tree, JSON-lines log, Chrome-trace/Perfetto
+  emitter, and the `jax.profiler` bridge.
+
+Everything is gated on ONE process-wide flag (`set_enabled`, the same
+contract as the old `timing._ENABLED`): disabled call sites cost one
+flag check and perform no device syncs. `utils.timing` remains as a
+thin compatibility shim over this package.
+
+Quick start::
+
+    from combblas_tpu import obs
+    obs.set_enabled(True)
+    with obs.span("my_region"):
+        run_workload()
+    print(obs.export.format_report())
+    print(obs.export.phase_breakdown())      # {category: s, "unaccounted": s}
+    obs.export.chrome_trace("trace.json")    # open in ui.perfetto.dev
+"""
+
+from combblas_tpu.obs import export, metrics, trace
+from combblas_tpu.obs.trace import (
+    CATEGORIES, TRACER, Tracer, enabled, reset, set_enabled, span, sync,
+)
+from combblas_tpu.obs.metrics import REGISTRY, counter, gauge, histogram
+from combblas_tpu.obs.export import (
+    chrome_trace, format_report, phase_breakdown, profiler_trace, report,
+    to_jsonl,
+)

@@ -1,0 +1,178 @@
+"""Exporters for span records: breakdowns, report tree, JSON-lines,
+Chrome trace (Perfetto), and the jax.profiler bridge.
+
+All readers take an optional `tracer` (default: the process-wide
+`trace.TRACER`) and operate on a snapshot, so exporting while spans
+are still being recorded is safe.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+
+from combblas_tpu.obs import trace as _trace
+from combblas_tpu.obs.trace import UNACCOUNTED, SpanRecord, Tracer
+
+
+def _records(tracer: Tracer | None) -> list[SpanRecord]:
+    return (_trace.TRACER if tracer is None else tracer).snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Category breakdown — the headline artifact (BENCH `phase_breakdown`)
+# ---------------------------------------------------------------------------
+
+def phase_breakdown(tracer: Tracer | None = None,
+                    records: list[SpanRecord] | None = None) -> dict:
+    """{category: seconds} over every record's SELF time, plus
+    `"unaccounted"` (self time of category-less spans, region roots
+    included) and `"total"` (summed top-level span durations). The
+    invariant sum(categories) + unaccounted == total holds exactly,
+    so the residual is an honest measurement, not a guess."""
+    recs = records if records is not None else _records(tracer)
+    out = {UNACCOUNTED: 0.0}
+    total = 0.0
+    for r in recs:
+        key = r.category if r.category is not None else UNACCOUNTED
+        out[key] = out.get(key, 0.0) + r.self_s
+        if r.depth == 0:
+            total += r.total_s
+    # self_s clamps tiny negative jitter to 0, which can leave the
+    # parts a hair over the whole; fold the difference into the
+    # residual so the invariant is exact
+    out[UNACCOUNTED] = max(total - sum(v for k, v in out.items()
+                                       if k != UNACCOUNTED), 0.0)
+    out["total"] = total
+    return out
+
+
+def unaccounted_s(tracer: Tracer | None = None) -> float:
+    return phase_breakdown(tracer)[UNACCOUNTED]
+
+
+# ---------------------------------------------------------------------------
+# Human report tree (self/total per span path)
+# ---------------------------------------------------------------------------
+
+def report(tracer: Tracer | None = None,
+           records: list[SpanRecord] | None = None) -> dict:
+    """Aggregate records by PATH into a nested tree:
+    {name: {"calls", "total_s", "self_s", "category", "children": {...}}}.
+    Paths aggregate across repeats (every window/iteration of a loop
+    folds into one node)."""
+    recs = records if records is not None else _records(tracer)
+    root: dict = {}
+    for r in sorted(recs, key=lambda r: len(r.path)):
+        level = root
+        for name in r.path[:-1]:
+            node = level.get(name)
+            if node is None:   # orphan (parent open or dropped): stub it
+                node = level[name] = {"calls": 0, "total_s": 0.0,
+                                      "self_s": 0.0, "category": None,
+                                      "children": {}}
+            level = node["children"]
+        node = level.setdefault(r.path[-1], {
+            "calls": 0, "total_s": 0.0, "self_s": 0.0,
+            "category": r.category, "children": {}})
+        node["calls"] += 1
+        node["total_s"] += r.total_s
+        node["self_s"] += r.self_s
+    return root
+
+
+def format_report(tracer: Tracer | None = None, indent: int = 2,
+                  min_s: float = 0.0) -> str:
+    """Render the report tree for terminals: one line per span path,
+    total/self seconds, call count, category."""
+    lines = [f"{'span':<44} {'total_s':>10} {'self_s':>10} "
+             f"{'calls':>7}  category"]
+
+    def walk(tree: dict, depth: int):
+        for name, node in sorted(tree.items(),
+                                 key=lambda kv: -kv[1]["total_s"]):
+            if node["total_s"] >= min_s:
+                label = " " * (indent * depth) + name
+                lines.append(
+                    f"{label:<44} {node['total_s']:>10.4f} "
+                    f"{node['self_s']:>10.4f} {node['calls']:>7}  "
+                    f"{node['category'] or '-'}")
+            walk(node["children"], depth + 1)
+
+    walk(report(tracer), 0)
+    bd = phase_breakdown(tracer)
+    total = bd.pop("total")
+    lines.append(f"{'-- breakdown --':<44} {total:>10.4f}")
+    for k, v in sorted(bd.items(), key=lambda kv: -kv[1]):
+        pct = 100.0 * v / total if total else 0.0
+        lines.append(f"  {k:<42} {v:>10.4f} {pct:>9.1f}%")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# JSON-lines event log (round-trippable)
+# ---------------------------------------------------------------------------
+
+def to_jsonl(path, tracer: Tracer | None = None) -> int:
+    """One JSON object per completed span; returns the record count."""
+    recs = _records(tracer)
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r.to_dict()) + "\n")
+    return len(recs)
+
+
+def read_jsonl(path) -> list[SpanRecord]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            out.append(SpanRecord(
+                d["name"], d["category"], d["t0"], d["t1"], d["depth"],
+                tuple(d["path"]), d["tid"], d["attrs"], d["children_s"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace (chrome://tracing / https://ui.perfetto.dev)
+# ---------------------------------------------------------------------------
+
+def chrome_trace(path, tracer: Tracer | None = None) -> int:
+    """Emit complete ("ph": "X") events, microsecond timestamps
+    rebased to the earliest span. Category and attrs land in `args`;
+    `cat` enables Perfetto's category filter."""
+    recs = _records(tracer)
+    t_base = min((r.t0 for r in recs), default=0.0)
+    events = [{
+        "name": r.name,
+        "cat": r.category or "other",
+        "ph": "X",
+        "ts": (r.t0 - t_base) * 1e6,
+        "dur": r.total_s * 1e6,
+        "pid": 0,
+        "tid": r.tid % 2 ** 31,   # Chrome wants a small-ish int
+        "args": {"path": "/".join(r.path), "self_s": round(r.self_s, 6),
+                 **r.attrs},
+    } for r in recs]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+# ---------------------------------------------------------------------------
+# jax.profiler bridge (XLA op-level breakdown; TensorBoard/xprof)
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def profiler_trace(logdir: str):
+    """jax.profiler trace context — the XLA-level phase breakdown
+    (open the logdir with TensorBoard / xprof). The spans above answer
+    "where did the wall clock go"; this answers "which XLA ops"."""
+    import jax
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

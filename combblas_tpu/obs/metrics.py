@@ -1,0 +1,193 @@
+"""Metrics registry: labeled counters, gauges, and histograms.
+
+The quantities that are NOT wall time — nnz processed, flops planned,
+bytes read back from device, compile-cache (CapLadder) hits/misses,
+phase counts. Prometheus-shaped (name + sorted label set -> series)
+but in-process only: `REGISTRY.snapshot()` returns plain dicts for the
+bench JSON artifacts.
+
+Gated on the same process-wide flag as spans (`trace.set_enabled`):
+disabled updates are one flag check. Registration itself is always
+allowed (module-level handles are cheap and keep hot loops free of
+dict lookups).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+from combblas_tpu.obs import trace as _trace
+
+
+def _key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class Counter:
+    """Monotonically increasing sum per label set."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._series: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1, **labels) -> None:
+        if not _trace._ENABLED:
+            return
+        if value < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        k = _key(labels)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0) + value
+
+    def value(self, **labels) -> float:
+        return self._series.get(_key(labels), 0)
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "help": self.help,
+                "series": [{"labels": dict(k), "value": v}
+                           for k, v in sorted(self._series.items())]}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Gauge:
+    """Last-write-wins value per label set."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._series: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, value: float, **labels) -> None:
+        if not _trace._ENABLED:
+            return
+        with self._lock:
+            self._series[_key(labels)] = value
+
+    def value(self, **labels):
+        return self._series.get(_key(labels))
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "help": self.help,
+                "series": [{"labels": dict(k), "value": v}
+                           for k, v in sorted(self._series.items())]}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+#: power-of-4 default bounds: 1 .. 4^15 ≈ 1.07e9 covers counts from
+#: single entries to the 2^30 expansion ceiling in 16 buckets
+_DEFAULT_BOUNDS = tuple(4 ** k for k in range(16))
+
+
+class Histogram:
+    """Cumulative-bucket histogram per label set (Prometheus shape:
+    bucket[i] counts observations <= bounds[i]; +Inf is implicit via
+    `count`). Tracks sum/count/min/max too."""
+
+    def __init__(self, name: str, help: str = "",
+                 bounds: tuple = _DEFAULT_BOUNDS):
+        self.name = name
+        self.help = help
+        self.bounds = tuple(sorted(bounds))
+        self._series: dict[tuple, dict] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, **labels) -> None:
+        if not _trace._ENABLED:
+            return
+        k = _key(labels)
+        with self._lock:
+            s = self._series.get(k)
+            if s is None:
+                s = self._series[k] = {
+                    "buckets": [0] * len(self.bounds), "sum": 0.0,
+                    "count": 0, "min": value, "max": value}
+            i = bisect.bisect_left(self.bounds, value)
+            if i < len(self.bounds):
+                s["buckets"][i] += 1
+            s["sum"] += value
+            s["count"] += 1
+            s["min"] = min(s["min"], value)
+            s["max"] = max(s["max"], value)
+
+    def series(self, **labels) -> dict | None:
+        s = self._series.get(_key(labels))
+        if s is None:
+            return None
+        # cumulative buckets on read (updates stay O(1) per observe)
+        cum, tot = [], 0
+        for b in s["buckets"]:
+            tot += b
+            cum.append(tot)
+        return {**s, "buckets": cum, "bounds": list(self.bounds)}
+
+    def snapshot(self) -> dict:
+        return {"type": "histogram", "help": self.help,
+                "bounds": list(self.bounds),
+                "series": [{"labels": dict(k), **self.series(**dict(k))}
+                           for k in sorted(self._series)]}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Registry:
+    """Name -> metric map. Re-registering a name returns the existing
+    metric (so module-level handles in different files can share one
+    series) but a TYPE clash is an error."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_make(self, cls, name, *args, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, *args, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_make(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_make(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  bounds: tuple = _DEFAULT_BOUNDS) -> Histogram:
+        return self._get_or_make(Histogram, name, help, bounds)
+
+    def snapshot(self) -> dict:
+        """{name: snapshot} for every metric that has data."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in items
+                if m.snapshot()["series"]}
+
+    def reset(self) -> None:
+        """Clear every metric's series (registrations persist)."""
+        with self._lock:
+            items = list(self._metrics.values())
+        for m in items:
+            m.reset()
+
+
+REGISTRY = Registry()
+
+counter = REGISTRY.counter
+gauge = REGISTRY.gauge
+histogram = REGISTRY.histogram

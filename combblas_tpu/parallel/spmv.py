@@ -154,26 +154,32 @@ def spmsv_timed(sr: Semiring, a: DistSpMat, y_prev: DistSpVec,
     kernel, then the fan-in collective. Each phase is a separate
     dispatch blocked to completion, so the split is honest wall-clock
     (the fused `spmsv` is faster — use this for attribution, not
-    production). Stamps utils.timing.GLOBAL unless ``timers`` given.
-    """
+    production). Stamps utils.timing.GLOBAL unless ``timers`` given,
+    and records categorized obs spans under `spmsv_timed` (fan_out =
+    transfer, local = device_execute, fan_in = transfer)."""
+    from combblas_tpu import obs
     from combblas_tpu.utils import timing as tm
     t = timers if timers is not None else tm.GLOBAL
     was = tm.enabled()
     tm.set_enabled(True)   # this entry point EXISTS for attribution
     try:
-        with t.phase("fan_out"):
-            xdd, xad = _spmsv_fanout(
-                y_prev.grid, y_prev.axis, y_prev.glen, a.tile_n,
-                y_prev.data, y_prev.active, sr.zero())
-            x = DistSpVec(xdd, xad, a.grid, COL_AXIS, a.ncols)
-            tm.sync(x.data)   # value readback: block_until_ready can
-            #                   ack early on remote-TPU relays
-        with t.phase("local"):
-            yp, hp = _spmsv_local(sr, a, x)
-            tm.sync(yp)
-        with t.phase("fan_in"):
-            out = _spmsv_fanin(sr, a, yp, hp)
-            tm.sync(out.data)
+        with obs.span("spmsv_timed"):
+            with t.phase("fan_out"), \
+                    obs.span("fan_out", category="transfer"):
+                xdd, xad = _spmsv_fanout(
+                    y_prev.grid, y_prev.axis, y_prev.glen, a.tile_n,
+                    y_prev.data, y_prev.active, sr.zero())
+                x = DistSpVec(xdd, xad, a.grid, COL_AXIS, a.ncols)
+                tm.sync(x.data)   # value readback: block_until_ready can
+                #                   ack early on remote-TPU relays
+            with t.phase("local"), \
+                    obs.span("local", category="device_execute"):
+                yp, hp = _spmsv_local(sr, a, x)
+                tm.sync(yp)
+            with t.phase("fan_in"), \
+                    obs.span("fan_in", category="transfer"):
+                out = _spmsv_fanin(sr, a, yp, hp)
+                tm.sync(out.data)
     finally:
         tm.set_enabled(was)
     # 'merge' is fused into the fan-in collective on TPU (the monoid

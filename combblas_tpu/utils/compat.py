@@ -1,0 +1,48 @@
+"""JAX version-compatibility shims, installed at package import.
+
+The codebase targets the current JAX surface (`jax.shard_map` with
+``check_vma``, `lax.pvary`); the pinned environment may carry an older
+release where shard_map still lives under `jax.experimental.shard_map`
+(with ``check_rep`` in place of ``check_vma``) and `pvary`/`pcast` do
+not exist. Each shim is installed only when the attribute is missing,
+so on a new-enough JAX this module is a no-op — the shims can be
+deleted wholesale once the pinned JAX catches up.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def install() -> None:
+    """Idempotently install the shims onto the jax modules."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      check_vma=None, **kw):
+            # the new API's check_vma plays the old check_rep's role.
+            # Default to False: the old checker has no replication
+            # rule for while/cond (NotImplementedError on bodies the
+            # new-JAX checker accepts), so code written against the
+            # new default can't run checked here anyway.
+            if "check_rep" not in kw:
+                kw["check_rep"] = bool(check_vma) if check_vma is not None \
+                    else False
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(lax, "pvary") and not hasattr(lax, "pcast"):
+        # pvary only re-annotates varying mesh axes for the new
+        # shard_map type system; data-wise it is the identity, which
+        # is exactly right under the old check_rep machinery
+        def pvary(x, axis_name=None):
+            return x
+
+        lax.pvary = pvary
+
+
+install()

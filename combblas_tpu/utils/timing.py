@@ -1,17 +1,20 @@
-"""Phase-taxonomy timers and profiler hooks.
+"""Phase-taxonomy timers — THIN COMPATIBILITY SHIM over `combblas_tpu.obs`.
 
-Capability parity: the reference's TIMING accumulators
-(cblas_alltoalltime / allgathertime / localspmvtime / mergeconttime /
-transvectime, CombBLAS.h:78-100, stamped around each SpMV/SpGEMM phase
-e.g. ParFriends.h:1743-1879) and its Fan-Out/LocalSpMV/Fan-In/Merge
-PAPI phase matrices (papi_combblas_globals.h).
+The span tracer (`obs.trace`), metrics registry (`obs.metrics`) and
+exporters (`obs.export`) supersede this module; it remains so existing
+callers keep working unchanged:
 
-TPU-native re-design: inside one jitted program XLA fuses the phases,
-so wall-clock attribution happens at two levels: (1) host-level named
-accumulators (`Timers`) around eager or per-call stages — the
-MPI_Wtime analogue; (2) `trace()` wraps `jax.profiler` so the XLA
-op-level breakdown (the true fan-out/local/fan-in/merge split of a
-fused step) lands in a TensorBoard-readable trace directory.
+* `Timers` / `GLOBAL` — the named wall-clock accumulators (≅ the
+  reference's cblas_* TIMING globals, CombBLAS.h:78-100), still a
+  standalone implementation (spmv.spmsv_timed and tests use it
+  directly).
+* `enabled` / `set_enabled` / `sync` — delegate to `obs.trace`: ONE
+  process-wide flag arms both the legacy accumulators' device syncs
+  and the span tracer.
+* `trace` — the jax.profiler bridge, now `obs.export.profiler_trace`.
+
+New instrumentation should open `obs.span(...)` regions instead; see
+`combblas_tpu/obs/__init__.py`.
 """
 
 from __future__ import annotations
@@ -22,6 +25,9 @@ from collections import defaultdict
 from typing import Callable
 
 import jax
+
+from combblas_tpu.obs import trace as _trace
+from combblas_tpu.obs.export import profiler_trace as trace  # noqa: F401
 
 #: the reference's phase taxonomy (papi_combblas_globals.h)
 PHASES = ("fan_out", "local", "fan_in", "merge")
@@ -66,48 +72,11 @@ class Timers:
                   f"  ({v['mean_ms']:.3f} ms/call)")
 
 
-#: process-wide accumulators, stamped by the instrumented drivers
-#: (spmv.spmsv_timed, spgemm's phased paths, models.mcl) — the
-#: cblas_* globals analogue. Callers snapshot/reset around a region:
-#:     GLOBAL.totals.clear(); GLOBAL.counts.clear()
+#: process-wide accumulators — kept for direct users (spmsv_timed,
+#: scripts); the instrumented drivers now record obs spans instead
 GLOBAL = Timers()
 
-#: phase SYNC gate (≅ compiling the reference with -DTIMING): when
-#: off (default), instrumented drivers stamp dispatch-time only and
-#: skip their forced device syncs — production calls pay nothing.
-_ENABLED = False
-
-
-def enabled() -> bool:
-    return _ENABLED
-
-
-def set_enabled(on: bool) -> None:
-    global _ENABLED
-    _ENABLED = on
-
-
-def sync(x) -> None:
-    """Force completion with a tiny data-DEPENDENT readback: on
-    remote-TPU relays block_until_ready can ack before execution
-    finishes, so honest phase boundaries fetch a value (one element,
-    via a device-side slice — not the whole array). No-op when phase
-    timing is disabled."""
-    if not _ENABLED:
-        return
-    import numpy as np
-    for leaf in jax.tree_util.tree_leaves(x):
-        if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
-            np.asarray(leaf.ravel()[0])
-            return
-
-
-@contextlib.contextmanager
-def trace(logdir: str):
-    """jax.profiler trace context — the XLA-level phase breakdown
-    (open the logdir with TensorBoard / xprof)."""
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+#: the sync/span gate moved to obs.trace (one switch for both systems)
+enabled = _trace.enabled
+set_enabled = _trace.set_enabled
+sync = _trace.sync

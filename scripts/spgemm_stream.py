@@ -38,33 +38,62 @@ from combblas_tpu.parallel import spgemm as spg
 from combblas_tpu.parallel.grid import ProcGrid
 
 
-def plan_rowblocks(at: tl.Tile, budget: int):
-    """Row-aligned A-entry block plan for A*A: [(elo, flops)] cuts at
-    row boundaries by cumulative flops, plus the shared static caps.
-    Host traffic is two O(nrows) readbacks (row flops + row starts) —
-    NOT the O(cap) entry arrays."""
+def _rowflops_int64(at: tl.Tile, _force_slice_len=None):
+    """Exact per-row flop totals for A*A as int64 on the host, plus the
+    host row-starts array.
+
+    x64 is disabled on device, so flops accumulate in two int32 halves
+    (pe = lo + hi << s, s chosen so both halves are < 2**s). A half
+    scatter-add is only trusted when its worst-case per-row sum is
+    PROVABLY under 2^31:
+
+      * common path — max_row_nnz < 2^(31-s), so even a row receiving
+        every entry sums each half to < max_row_nnz * 2^s < 2^31:
+        one pass, two O(nrows) readbacks;
+      * hub-row fallback — the entry axis is sliced into <= 2^(30-s)
+        entry chunks, so each slice's per-row half-sums are < 2^30
+        no matter how the entries distribute.
+
+    Slices combine on the host in int64, which is exact. No path can
+    wrap (the old single-pass 16/16 split could wrap past 2^32 back to
+    positive on extreme hub rows and pass a non-negativity check)."""
     pe = tl.spgemm_flops_per_entry(at, at)              # (cap,) device
-    rows = jnp.clip(at.rows, 0, at.nrows)
-    # accumulate per-row flops in two int32 halves (x64 is disabled on
-    # device) and recombine in int64 on host: a single-half int32
-    # scatter-add can wrap PAST 2^32 back to positive on extreme hub
-    # rows, silently corrupting the plan and the published metric
-    pe_lo = pe & 0xFFFF
-    pe_hi = pe >> 16
-    lo_d = jnp.zeros((at.nrows + 1,), jnp.int32).at[rows].add(
-        pe_lo, mode="drop")[:at.nrows]
-    hi_d = jnp.zeros((at.nrows + 1,), jnp.int32).at[rows].add(
-        pe_hi, mode="drop")[:at.nrows]
-    # halves stay exact while each stays under 2^31: lo sums <= nnz_row
-    # * 2^16, hi sums <= nnz_row * (max_pe >> 16) — fine to ~2^14-entry
-    # rows with 2^30-flop entries; verify non-negativity anyway
-    lo = np.asarray(lo_d).astype(np.int64)
-    hi = np.asarray(hi_d).astype(np.int64)
-    if (lo < 0).any() or (hi < 0).any():
-        raise ValueError("row-flop half-accumulators overflowed int32; "
-                         "split rows or widen the accumulation")
-    rowfl = lo + (hi << 16)
-    aptr = np.asarray(tl.row_starts(at))                # (nrows+1,)
+    rows = jnp.clip(at.rows, 0, at.nrows)               # pad -> drop row
+    aptr = np.asarray(tl.row_starts(at)).astype(np.int64)   # (nrows+1,)
+    max_pe = int(np.asarray(jnp.max(pe))) if at.cap else 0
+    max_row = int(np.diff(aptr).max()) if at.nrows else 0
+    # split point: lo < 2^s by construction, hi = pe >> s < 2^s because
+    # s >= ceil(bit_length(max_pe) / 2)
+    s = max(1, (max(max_pe, 1).bit_length() + 1) // 2)
+    mask = (1 << s) - 1
+    if _force_slice_len is not None:        # tests: force the fallback
+        slice_len = _force_slice_len
+    elif max_row < (1 << (31 - s)):
+        slice_len = max(int(at.cap), 1)     # one provably-exact pass
+    else:
+        slice_len = 1 << (30 - s)
+    rowfl = np.zeros(at.nrows, np.int64)
+    for lo_e in range(0, int(at.cap), slice_len):
+        p = pe[lo_e:lo_e + slice_len]
+        r = rows[lo_e:lo_e + slice_len]
+        lo_d = jnp.zeros((at.nrows + 1,), jnp.int32).at[r].add(
+            p & mask, mode="drop")[:at.nrows]
+        hi_d = jnp.zeros((at.nrows + 1,), jnp.int32).at[r].add(
+            p >> s, mode="drop")[:at.nrows]
+        rowfl += np.asarray(lo_d).astype(np.int64)
+        rowfl += np.asarray(hi_d).astype(np.int64) << s
+    return rowfl, aptr
+
+
+def plan_rowblocks(at: tl.Tile, budget: int):
+    """Row-aligned A-entry block plan for A*A: [(elo, ehi, flops)] cuts
+    at row boundaries by cumulative flops, plus the shared static caps.
+    Host traffic is two O(nrows) readbacks (row flops + row starts) on
+    the common path — NOT the O(cap) entry arrays; pathological hub
+    rows add provably-exact entry slices (see _rowflops_int64). A
+    single row needing more than 2^30-1 products raises the 'expansion
+    ceiling' ValueError — the plan never silently wraps."""
+    rowfl, aptr = _rowflops_int64(at)
     cum = np.cumsum(rowfl)
     total = int(cum[-1]) if len(cum) else 0
     nblocks = max(1, -(-total // budget))

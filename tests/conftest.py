@@ -11,8 +11,13 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"   # force off the real-TPU tunnel
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "--xla_backend_optimization_level" not in _flags:
+    # the suite is compile-bound on CPU (tiny data, hundreds of jit
+    # kernels); skipping XLA's backend optimization pipeline halves
+    # wall time (test_mcl: 200 s -> 100 s) without changing semantics
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 

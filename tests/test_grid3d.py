@@ -46,6 +46,7 @@ def test_split_roundtrip_geometry(rng, grid2, grid3):
     assert total == a.getnnz()
 
 
+@pytest.mark.slow
 def test_summa3d_matches_2d(rng, grid2, grid3):
     n = 16
     da = _sparse(rng, n, n, 0.4)
@@ -56,6 +57,7 @@ def test_summa3d_matches_2d(rng, grid2, grid3):
     np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ db, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_summa3d_uneven_dims(rng, grid2, grid3):
     da = _sparse(rng, 13, 11, 0.4)
     db = _sparse(rng, 11, 15, 0.4)
@@ -66,10 +68,13 @@ def test_summa3d_uneven_dims(rng, grid2, grid3):
     np.testing.assert_allclose(dm.to_dense(got, 0.0), da @ db, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_spgemm_3d_phased_with_and_without_prune(rng, grid2, grid3):
     # one fixture matrix covers both the default (no-hook) branch and
     # the between-phase prune hook (columns are disjoint across
     # phases, so pruning per phase == pruning the product)
+    # slow: the 3D collectives compile for MINUTES each on the 1-core
+    # emulated-mesh CI host (10+ min for this test alone)
     n = 16
     da = _sparse(rng, n, n, 0.4)
     a = dm.from_dense(S.PLUS, grid2, da, 0.0)

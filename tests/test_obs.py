@@ -1,0 +1,325 @@
+"""Observability subsystem tests: span nesting/self-time math, the
+unaccounted residual invariant, the disabled-mode zero-overhead
+contract, metrics label aggregation, and exporter round-trips."""
+
+import json
+import time
+
+import pytest
+
+from combblas_tpu.obs import export, metrics, trace
+from combblas_tpu.utils import timing as tm
+
+
+@pytest.fixture
+def obs_on():
+    """Enable tracing around a test, restoring prior state and leaving
+    the global tracer/registry clean either way."""
+    was = trace.enabled()
+    trace.set_enabled(True)
+    trace.reset()
+    metrics.REGISTRY.reset()
+    yield trace.TRACER
+    trace.set_enabled(was)
+    trace.reset()
+    metrics.REGISTRY.reset()
+
+
+def _rec(name, category, t0, t1, depth, path, children_s=0.0, attrs=None):
+    return trace.SpanRecord(name, category, t0, t1, depth, tuple(path),
+                            tid=1, attrs=attrs or {}, children_s=children_s)
+
+
+# ---------------------------------------------------------------------------
+# span nesting + self-time math
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_self_time(obs_on):
+    tr = trace.Tracer()
+    with trace.span("root", tracer=tr):
+        time.sleep(0.01)
+        with trace.span("child", category="device_execute", tracer=tr):
+            time.sleep(0.02)
+    child, root = tr.records           # children close before parents
+    assert child.name == "child" and root.name == "root"
+    assert child.path == ("root", "child") and child.depth == 1
+    assert root.path == ("root",) and root.depth == 0
+    # the parent's children_s is exactly the child's duration
+    assert root.children_s == pytest.approx(child.total_s)
+    assert root.self_s == pytest.approx(root.total_s - child.total_s)
+    assert root.self_s >= 0.0 and child.self_s >= 0.0
+    assert child.total_s >= 0.02
+
+
+def test_span_attrs_and_set(obs_on):
+    tr = trace.Tracer()
+    with trace.span("w", tracer=tr, lo=3) as s:
+        s.set(nnz=17)
+    (rec,) = tr.records
+    assert rec.attrs == {"lo": 3, "nnz": 17}
+
+
+def test_span_rejects_unknown_category(obs_on):
+    with pytest.raises(ValueError, match="category"):
+        trace.span("x", category="gpu_time")
+
+
+def test_self_time_clamped_nonnegative():
+    # clock jitter can make children_s exceed total_s on empty spans
+    r = _rec("x", None, 0.0, 1.0, 0, ("x",), children_s=1.5)
+    assert r.self_s == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the unaccounted residual
+# ---------------------------------------------------------------------------
+
+def test_phase_breakdown_residual_math():
+    recs = [
+        _rec("root", None, 0.0, 1.0, 0, ("root",), children_s=0.7),
+        _rec("k", "device_execute", 0.1, 0.6, 1, ("root", "k")),
+        _rec("rb", "host_readback", 0.6, 0.8, 1, ("root", "rb")),
+    ]
+    bd = export.phase_breakdown(records=recs)
+    assert bd["device_execute"] == pytest.approx(0.5)
+    assert bd["host_readback"] == pytest.approx(0.2)
+    assert bd["total"] == pytest.approx(1.0)    # only the depth-0 span
+    # residual = the root's uncovered self time
+    assert bd["unaccounted"] == pytest.approx(0.3)
+
+
+def test_phase_breakdown_invariant_exact(obs_on):
+    tr = trace.Tracer()
+    with trace.span("region", tracer=tr):
+        with trace.span("plan", category="host_compute", tracer=tr):
+            time.sleep(0.005)
+        for _ in range(3):
+            with trace.span("win", tracer=tr):
+                with trace.span("mul", category="device_execute",
+                                tracer=tr):
+                    time.sleep(0.002)
+    bd = export.phase_breakdown(tr)
+    total = bd.pop("total")
+    # the invariant is exact BY CONSTRUCTION (residual recomputed as
+    # total - sum(categories)), so the residual is honest measurement
+    assert sum(bd.values()) == pytest.approx(total, abs=1e-12)
+    assert bd["unaccounted"] > 0.0             # structural span glue
+    assert bd["host_compute"] > 0.0
+    assert bd["device_execute"] > 0.0
+
+
+def test_unaccounted_helper(obs_on):
+    tr = trace.Tracer()
+    with trace.span("only_structural", tracer=tr):
+        time.sleep(0.003)
+    assert export.unaccounted_s(tr) == pytest.approx(
+        export.phase_breakdown(tr)["total"])
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: the zero-overhead contract
+# ---------------------------------------------------------------------------
+
+class _Detonator:
+    """Explodes on ANY attribute access: proves disabled-mode sync()
+    never inspects its argument (no tree flattening, no device sync)."""
+
+    def __getattribute__(self, name):
+        raise AssertionError(f"disabled obs touched .{name}")
+
+
+def test_disabled_span_is_shared_noop():
+    was = trace.enabled()
+    trace.set_enabled(False)
+    try:
+        s1 = trace.span("a", category="device_execute", big_attr=list(range(5)))
+        s2 = trace.span("b")
+        assert s1 is trace._NOOP and s2 is trace._NOOP  # no allocation
+        n0 = len(trace.TRACER.snapshot())
+        with trace.span("c") as s:
+            s.set(nnz=3)        # set() must be a no-op, not an error
+        assert len(trace.TRACER.snapshot()) == n0       # no record
+    finally:
+        trace.set_enabled(was)
+
+
+def test_disabled_sync_never_touches_argument():
+    was = trace.enabled()
+    trace.set_enabled(False)
+    try:
+        trace.sync(_Detonator())   # would raise if sync looked inside
+    finally:
+        trace.set_enabled(was)
+
+
+def test_disabled_metrics_do_not_record():
+    was = trace.enabled()
+    trace.set_enabled(False)
+    try:
+        c = metrics.Counter("t.disabled")
+        c.inc(5, kind="x")
+        assert c.value(kind="x") == 0
+        g = metrics.Gauge("t.disabled.g")
+        g.set(3.0)
+        assert g.value() is None
+        h = metrics.Histogram("t.disabled.h")
+        h.observe(10)
+        assert h.series() is None
+    finally:
+        trace.set_enabled(was)
+
+
+# ---------------------------------------------------------------------------
+# metrics: label aggregation + registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_label_aggregation(obs_on):
+    c = metrics.Counter("t.ops")
+    c.inc(kind="hit")
+    c.inc(kind="hit")
+    c.inc(3, kind="miss")
+    c.inc(7, b=2, a=1)
+    c.inc(5, a=1, b=2)          # kwarg order must not split the series
+    assert c.value(kind="hit") == 2
+    assert c.value(kind="miss") == 3
+    assert c.value(a=1, b=2) == 12
+    assert c.value(kind="absent") == 0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    snap = c.snapshot()
+    assert snap["type"] == "counter"
+    assert {tuple(sorted(s["labels"].items())): s["value"]
+            for s in snap["series"]} == {
+        (("a", 1), ("b", 2)): 12,
+        (("kind", "hit"),): 2,
+        (("kind", "miss"),): 3,
+    }
+
+
+def test_histogram_cumulative_buckets(obs_on):
+    h = metrics.Histogram("t.h", bounds=(1, 10, 100))
+    for v in (0.5, 5, 5, 50, 5000):
+        h.observe(v)
+    s = h.series()
+    assert s["buckets"] == [1, 3, 4]    # cumulative: <=1, <=10, <=100
+    assert s["count"] == 5              # +Inf implicit via count
+    assert s["min"] == 0.5 and s["max"] == 5000
+    assert s["sum"] == pytest.approx(5060.5)
+
+
+def test_registry_get_or_make_and_type_clash(obs_on):
+    r = metrics.Registry()
+    c1 = r.counter("x")
+    c2 = r.counter("x")
+    assert c1 is c2                     # shared handle across modules
+    with pytest.raises(TypeError):
+        r.gauge("x")
+    c1.inc(2)
+    snap = r.snapshot()
+    assert snap["x"]["series"][0]["value"] == 2
+    r.reset()
+    assert r.snapshot() == {}           # series cleared, registration kept
+    assert r.counter("x") is c1
+
+
+# ---------------------------------------------------------------------------
+# exporters: JSONL + Chrome-trace round trips, report tree
+# ---------------------------------------------------------------------------
+
+def _trace_a_region(tr):
+    with trace.span("region", tracer=tr, scale=4):
+        with trace.span("plan", category="host_compute", tracer=tr):
+            time.sleep(0.002)
+        for w in range(2):
+            with trace.span("win", tracer=tr, w=w):
+                with trace.span("mul", category="device_execute",
+                                tracer=tr):
+                    time.sleep(0.001)
+
+
+def test_jsonl_round_trip(obs_on, tmp_path):
+    tr = trace.Tracer()
+    _trace_a_region(tr)
+    p = tmp_path / "spans.jsonl"
+    n = export.to_jsonl(p, tr)
+    assert n == len(tr.records) == 6
+    back = export.read_jsonl(p)
+    for orig, rt in zip(tr.records, back):
+        assert rt.name == orig.name and rt.path == orig.path
+        assert rt.category == orig.category and rt.depth == orig.depth
+        assert rt.t0 == orig.t0 and rt.t1 == orig.t1
+        assert rt.attrs == orig.attrs
+    # a loaded log produces the identical breakdown
+    assert export.phase_breakdown(records=back) == \
+        export.phase_breakdown(tr)
+
+
+def test_chrome_trace_events(obs_on, tmp_path):
+    tr = trace.Tracer()
+    _trace_a_region(tr)
+    p = tmp_path / "trace.json"
+    n = export.chrome_trace(p, tr)
+    doc = json.loads(p.read_text())
+    ev = doc["traceEvents"]
+    assert n == len(ev) == 6
+    assert all(e["ph"] == "X" for e in ev)
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in ev)
+    byname = {e["name"]: e for e in ev}
+    assert byname["mul"]["cat"] == "device_execute"
+    assert byname["win"]["cat"] == "other"          # structural
+    assert byname["mul"]["args"]["path"] == "region/win/mul"
+    assert byname["region"]["args"]["scale"] == 4
+    # timestamps are rebased to the earliest span
+    assert min(e["ts"] for e in ev) == 0.0
+
+
+def test_report_tree_aggregates_repeats(obs_on):
+    tr = trace.Tracer()
+    _trace_a_region(tr)
+    tree = export.report(tr)
+    region = tree["region"]
+    assert region["calls"] == 1
+    win = region["children"]["win"]
+    assert win["calls"] == 2            # both windows fold into one node
+    mul = win["children"]["mul"]
+    assert mul["calls"] == 2 and mul["category"] == "device_execute"
+    assert win["total_s"] >= mul["total_s"]
+    txt = export.format_report(tr)
+    assert "region" in txt and "-- breakdown --" in txt
+
+
+def test_tracer_bounded_and_reset(obs_on):
+    tr = trace.Tracer(max_records=2)
+    for i in range(4):
+        with trace.span(f"s{i}", tracer=tr):
+            pass
+    assert len(tr.records) == 2 and tr.dropped == 2
+    tr.reset()
+    assert tr.records == [] and tr.dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# the utils.timing compat shim
+# ---------------------------------------------------------------------------
+
+def test_timing_shim_delegates_to_obs():
+    # the legacy public API survives and shares the obs enable flag
+    assert tm.PHASES == ("fan_out", "local", "fan_in", "merge")
+    assert isinstance(tm.GLOBAL, tm.Timers)
+    was = trace.enabled()
+    try:
+        tm.set_enabled(True)
+        assert trace.enabled() and tm.enabled()
+        tm.set_enabled(False)
+        assert not trace.enabled() and not tm.enabled()
+    finally:
+        trace.set_enabled(was)
+
+
+def test_timing_shim_timers_still_stamp():
+    t = tm.Timers()
+    with t.phase("local"):
+        time.sleep(0.002)
+    rep = t.report()
+    assert rep["local"]["calls"] == 1
+    assert rep["local"]["total_s"] >= 0.002
