@@ -274,6 +274,11 @@ def main():
     ap.add_argument("--verbose", action="store_true")
     args = ap.parse_args()
 
+    from combblas_tpu.utils.config import setup_compilation_cache
+    cache_dir = setup_compilation_cache()
+    if cache_dir:
+        print(f"# compile cache: {cache_dir}", file=sys.stderr, flush=True)
+
     import jax
     nchips = len(jax.devices())
 

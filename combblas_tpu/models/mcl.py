@@ -178,7 +178,9 @@ def _select_thr(need, packed):
 
 
 def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
-        verbose: bool = False) -> tuple[dv.DistVec, int, int]:
+        verbose: bool = False,
+        cap_ladder: Optional[spg.CapLadder] = None,
+        ) -> tuple[dv.DistVec, int, int]:
     """Cluster the graph ``a`` (≅ HipMCL, MCL.cpp:515). Returns
     (cluster labels r-aligned, #clusters, #iterations).
 
@@ -186,14 +188,19 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     {expand via phased pruned SpGEMM, inflate} until chaos < eps;
     interpret the attractor matrix by connected components of its
     support (≅ Interpret, MCL.cpp:373).
+
+    ``cap_ladder``: pre-seeded `spg.CapLadder` (e.g. `CapLadder.load`
+    of a previous run's rungs) — a warm ladder mints zero new rungs,
+    so a repeat run re-traces/re-compiles zero expansion shapes. The
+    ladder is mutated in place; callers can `save()` it afterwards.
     """
     if a.nrows != a.ncols:
         raise ValueError("mcl needs a square adjacency matrix")
     with obs.span("mcl"):
-        return _mcl_instrumented(a, params, verbose)
+        return _mcl_instrumented(a, params, verbose, cap_ladder)
 
 
-def _mcl_instrumented(a, params, verbose):
+def _mcl_instrumented(a, params, verbose, cap_ladder=None):
     # span taxonomy per iteration (≅ MCL.cpp's printed per-iteration
     # stats): `mcl_expand` is structural — its children are the phased
     # SpGEMM driver's plan/window/sort spans plus the cap-pin readback
@@ -213,7 +220,7 @@ def _mcl_instrumented(a, params, verbose):
     # prune shrinks nnz monotonically) mints the rungs; iterations 2..N
     # reuse them and hit the jit cache (VERDICT r4 missing #1: the
     # round-4 run spent ~90% of 2117 s in per-iteration recompiles)
-    ladder = spg.CapLadder()
+    ladder = spg.CapLadder() if cap_ladder is None else cap_ladder
     while ch > params.chaos_eps and it < params.max_iters:
         with obs.span("mcl_expand", it=it):
             a = spg.spgemm_phased(

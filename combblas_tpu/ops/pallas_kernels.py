@@ -211,3 +211,180 @@ def seg_scan_values(d2, f2, *, combine, ident_val,
     if narrow is not None:          # int8 rode i32 vregs; restore dtype
         return out.astype(narrow)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fused ESC expansion: propagate + B gathers + multiply + key encode,
+# one VMEM-resident pass per (BL, 128) block
+# ---------------------------------------------------------------------------
+#
+# The XLA fused expansion (tile._expand_finish_xla) is already one
+# multi-channel scan, but XLA materializes each of its stages in HBM:
+# the log2(L) scan passes, the two cap-sized B gathers, the multiply and
+# the key encode each round-trip flops_cap-sized arrays. Because
+# tile._expand_prep seeds every chunk-column's top row (making every
+# column scan self-contained — no cross-column carry), the WHOLE back
+# end fuses into one sequential-grid Pallas pass: per block, scan the 3
+# channels in VMEM (shared flags, Hillis-Steele), gather B's cols/vals
+# from a VMEM-resident copy of the B table, multiply, encode the fused
+# sort key, and write exactly two outputs. HBM traffic: 4 channel reads
+# + 2 writes per slot, vs ~log2(L)+6 array passes for the XLA back end.
+#
+# The B table must fit VMEM: gated on b.cap <= EXPAND_BMAX (2^19 slots
+# = 2 MB cols + <=2 MB vals alongside ~2 MB of block buffers). The MCL
+# and streaming planners bound window B caps well under this. i32 keys
+# only (the caller checks); interpret mode covers tests off-TPU. The
+# in-kernel flat gather is the one construct the seg-scan kernel does
+# not already exercise on hardware, so this kernel is OFF by default on
+# real TPUs until validated there: COMBBLAS_TPU_PALLAS_EXPAND=1 opts
+# in, =interpret forces interpret mode (tests), =0 force-disables; the
+# XLA fused back end remains the production default and the reference.
+
+EXPAND_BMAX = 1 << 19          # max B-table slots kept VMEM-resident
+
+
+def expand_mode() -> str:
+    return os.environ.get("COMBBLAS_TPU_PALLAS_EXPAND", "")
+
+
+def expand_enabled() -> bool:
+    """Use the Pallas fused-expansion kernel? Opt-IN on TPU backends
+    (=1; unvalidated-on-hardware gather, see module comment), or
+    anywhere under =interpret (tests); =0 / unset-off-TPU disable.
+    COMBBLAS_TPU_PALLAS=0 still vetoes everything."""
+    mode = expand_mode()
+    if mode == "interpret":
+        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"
+    return mode == "1" and enabled()
+
+
+def expand_interpret() -> bool:
+    return expand_mode() == "interpret"
+
+
+def _fused_expand_kernel(scal_ref, rowv_ref, dv_ref, av_ref, f_ref,
+                         bc_ref, bv_ref, key_ref, cval_ref,
+                         rcar, dcar, acar, fcar,
+                         *, multiply, stride, nrows, L, flops_cap, bcap):
+    import jax.experimental.pallas as pl
+    from jax import lax
+
+    i = pl.program_id(0)
+    col_lo = scal_ref[0]
+    total = scal_ref[1]
+    f = f_ref[...]                 # start flags, pre-widened to int32
+    row = rowv_ref[...]
+    dl = dv_ref[...]
+    av = av_ref[...]
+    bl, C = row.shape
+    # joint Hillis-Steele copy-forward: ONE flag or-prefix drives all
+    # three channels (the zero pad is safe: uncovered top rows are
+    # patched by the carry below, and with column-top seeding block 0
+    # has no uncovered rows at all)
+    shift = 1
+    while shift < bl:
+
+        def prev(x):
+            return jnp.concatenate(
+                [jnp.zeros((shift, C), x.dtype), x[:-shift]], axis=0)
+
+        keep = f != 0
+        row = jnp.where(keep, row, prev(row))
+        dl = jnp.where(keep, dl, prev(dl))
+        av = jnp.where(keep, av, prev(av))
+        f = f | prev(f)
+        shift *= 2
+
+    @pl.when(i == 0)
+    def _init():
+        rcar[...] = jnp.zeros_like(rcar)
+        dcar[...] = jnp.zeros_like(dcar)
+        acar[...] = jnp.zeros_like(acar)
+        fcar[...] = jnp.zeros_like(fcar)
+
+    keep = f != 0
+    row = jnp.where(keep, row, rcar[0:1, :])
+    dl = jnp.where(keep, dl, dcar[0:1, :])
+    av = jnp.where(keep, av, acar[0:1, :])
+    ftot = f | fcar[0:1, :]
+    rcar[0:1, :] = row[-1:, :]
+    dcar[0:1, :] = dl[-1:, :]
+    acar[0:1, :] = av[-1:, :]
+    fcar[0:1, :] = ftot[-1:, :]
+
+    lidx = lax.broadcasted_iota(jnp.int32, (bl, C), 0) + i * bl
+    cidx = lax.broadcasted_iota(jnp.int32, (bl, C), 1)
+    slot = cidx * L + lidx         # sequence position of (l, c)
+    bidx = jnp.clip(dl + slot, 0, bcap - 1)
+    tabc = bc_ref[...]
+    tabv = bv_ref[...]
+    bcol = tabc[bidx // 128, bidx % 128]
+    bval = tabv[bidx // 128, bidx % 128]
+    live = (lidx < L) & (slot < total) & (slot < flops_cap)
+    kmax = (nrows + 1) * stride - 1
+    key_ref[...] = jnp.where(live, row * stride + (bcol - col_lo),
+                             jnp.asarray(kmax, jnp.int32))
+    cval_ref[...] = multiply(av, bval).astype(cval_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("multiply", "stride", "nrows",
+                                             "L", "flops_cap", "interpret"))
+def fused_expand(rowv2, deltav2, avalv2, f2, bcols, bvals, col_lo, total,
+                 *, multiply, stride: int, nrows: int, L: int,
+                 flops_cap: int, interpret: bool = False):
+    """One-pass fused ESC expansion over the seeded chunk-column layout
+    from tile._expand_prep. Returns (key, cval) in sequence order,
+    length flops_cap — bit-identical to tile._expand_finish_xla (same
+    propagation recurrence, same gathers, same encode). bool/int8
+    channels must be pre-widened to int32 by the caller (Mosaic has no
+    i1/i8 vector compute); ``multiply`` must be cache-stable."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, C = rowv2.shape
+    nblk = -(-L // _BL)
+    padL = nblk * _BL
+    if padL != L:
+        padr = ((0, padL - L), (0, 0))
+        rowv2 = jnp.pad(rowv2, padr)
+        deltav2 = jnp.pad(deltav2, padr)
+        avalv2 = jnp.pad(avalv2, padr)
+        f2 = jnp.pad(f2, padr, constant_values=True)
+    f2 = f2.astype(jnp.int32)
+    bcap = bcols.shape[0]
+    bn = -(-bcap // 128)
+    padB = bn * 128 - bcap
+    if padB:
+        bcols = jnp.pad(bcols, (0, padB))
+        bvals = jnp.pad(bvals, (0, padB))
+    out_dtype = jax.eval_shape(
+        multiply, jax.ShapeDtypeStruct((), avalv2.dtype),
+        jax.ShapeDtypeStruct((), bvals.dtype)).dtype
+    scal = jnp.stack([jnp.asarray(col_lo, jnp.int32),
+                      jnp.asarray(total, jnp.int32)])
+    kernel = functools.partial(_fused_expand_kernel, multiply=multiply,
+                               stride=stride, nrows=nrows, L=L,
+                               flops_cap=flops_cap, bcap=bcap)
+    blk = lambda: pl.BlockSpec((_BL, C), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    tab = lambda: pl.BlockSpec((bn, 128), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM)
+    key, cval = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[
+            pl.BlockSpec((2,), lambda i: (0,), memory_space=pltpu.SMEM),
+            blk(), blk(), blk(), blk(), tab(), tab(),
+        ],
+        out_specs=[blk(), blk()],
+        out_shape=[_sds((padL, C), jnp.int32, rowv2),
+                   _sds((padL, C), out_dtype, rowv2)],
+        scratch_shapes=[pltpu.VMEM((8, C), jnp.int32),
+                        pltpu.VMEM((8, C), jnp.int32),
+                        pltpu.VMEM((8, C), avalv2.dtype),
+                        pltpu.VMEM((8, C), jnp.int32)],
+        interpret=interpret,
+    )(scal, rowv2, deltav2, avalv2, f2,
+      bcols.reshape(bn, 128), bvals.reshape(bn, 128))
+    return (key[:L].T.reshape(-1)[:flops_cap],
+            cval[:L].T.reshape(-1)[:flops_cap])

@@ -40,14 +40,34 @@ SpGEMM pipeline is therefore built from sorts and scans with exactly
 two gathers (the B-side expansion), and the A-side per-slot values are
 *scan-propagated* (scatter one value per run start, copy it forward
 with a segmented scan) instead of gathered.
+
+Round-6 rework (this file + ops/pallas_kernels.py): sort cost scales
+with the OPERAND count per pass, so every 2-key sort above collapses
+onto ONE fused integer key — key = row*stride + (col - col_lo),
+stride = width+1, with the padding sentinel kmax = (nrows+1)*stride-1
+reserved so padding still sorts last (codec comment above
+`fused_keys_enabled`). Each ESC sort pass now carries (key, payload)
+instead of (row, col, payload) — 6 sorted operands -> 4 across the
+expand sort + dedup re-sort — and rows/cols rematerialize by ONE
+decode over out_cap, not the flops_cap-length expansion. The three
+expansion seg_propagate scans fuse into one shared-flag multi-channel
+scan (`_propagate_multi`), seeded at column tops so no cross-column
+stitch remains; the same preparation feeds an optional Pallas kernel
+(`pallas_kernels.fused_expand`) doing the scan + both B-side gathers
++ the semiring multiply in one VMEM pass per block. Measured by
+scripts/esc_microbench.py -> ESC_MICROBENCH.json (per-slot timings +
+per-variant pass accounting; tests/test_hlo_passes.py pins the pass
+structure); bit-exactness of every variant is proven in
+tests/test_fused_key.py + tests/test_pallas_expand.py.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from functools import partial
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -142,19 +162,119 @@ def _unsortable(vals: Array, restore) -> Array:
     return vals.astype(restore) if restore is not None else vals
 
 
-def sort_compress(add: Monoid, srows: Array, scols: Array, vals: Array,
-                  nlive: Array, *, nrows: int, ncols: int, cap: int,
-                  dedup: bool = True):
-    """Shared COO→Tile compression: one 2-key sort (which compacts AND
-    pads, because invalid entries carry the (nrows, ncols) sentinel that
-    is also the padding convention), a segmented-scan dedup, and — only
-    when deduping — a second sort to re-compact the surviving group
-    tails. Inputs must already be sentinel-masked; ``nlive`` is the
-    number of non-sentinel entries. Returns (tile, live_group_count).
+# ---------------------------------------------------------------------------
+# Fused (row, col) sort keys — one comparator key instead of two
+# ---------------------------------------------------------------------------
+#
+# lax.sort with num_keys=2 runs the comparator over BOTH key arrays at
+# every compare-exchange; fusing (row, col) into one integer key halves
+# the comparator bandwidth and drops one cap-sized operand from every
+# sort in the ESC pipeline. Layout:
+#
+#     key = row * stride + (col - col_lo),  stride = width + 1
+#
+# with width = ncols for whole-tile sorts or the static column-window
+# width for windowed SpGEMM (col_lo is the traced window base; a
+# *static* width keeps the i32 path reachable for windows of huge
+# matrices whose full nrows*ncols would overflow). The +1 in the
+# stride reserves key space for the padding sentinel
+#
+#     kmax = (nrows + 1) * stride - 1
+#
+# which is strictly greater than every live key (live keys are at most
+# (nrows-1)*stride + width = nrows*stride - 1 < kmax), so padding still
+# sorts last — the Tile invariant. i32 keys require kmax <= 2^31-1;
+# otherwise i64 (only when jax_enable_x64 — device x64 is disabled in
+# this repo) or the 2-key reference path (fused_key_info -> None).
 
-    This replaces the round-3 lexsort + argsort-compaction + gather
-    chain (~8 passes over the expansion) with 2-3 passes.
-    """
+def fused_keys_enabled() -> bool:
+    """Env opt-out: COMBBLAS_TPU_FUSED_KEY=0 forces the 2-key sorts."""
+    return os.environ.get("COMBBLAS_TPU_FUSED_KEY", "") != "0"
+
+
+def fused_key_info(nrows: int, ncols: int, width: Optional[int] = None):
+    """(stride, key dtype) for the fused (row, col) key space of an
+    (nrows, ncols)-shaped tile — or None when no integer dtype can hold
+    the sentinel key (callers fall back to the 2-key sort). ``width``
+    narrows the column span for window-relative keys (see module-level
+    comment); it must bound ``col - col_lo`` for every live entry."""
+    w = int(ncols if width is None else width)
+    stride = w + 1
+    kmax = (int(nrows) + 1) * stride - 1
+    if kmax <= 2**31 - 1:
+        return stride, jnp.int32
+    if jax.config.jax_enable_x64 and kmax <= 2**63 - 1:
+        return stride, jnp.int64
+    return None
+
+
+def encode_key(rows: Array, cols: Array, *, nrows: int, stride: int,
+               dtype, col_lo=0) -> Array:
+    """rows/cols -> fused sort key; any row >= nrows (the padding /
+    masked-out sentinel) maps to kmax so it sorts last regardless of
+    its col. ``col_lo`` may be traced (window base)."""
+    kmax = (int(nrows) + 1) * int(stride) - 1
+    k = (rows.astype(dtype) * jnp.asarray(stride, dtype)
+         + (cols.astype(dtype) - jnp.asarray(col_lo, dtype)))
+    return jnp.where(rows >= nrows, jnp.asarray(kmax, dtype), k)
+
+
+def decode_key(key: Array, *, nrows: int, ncols: int, stride: int,
+               col_lo=0) -> tuple[Array, Array]:
+    """Fused key -> (rows, cols) int32; sentinel keys (row part >=
+    nrows) decode to the canonical (nrows, ncols) padding coordinates."""
+    r = (key // stride).astype(jnp.int32)
+    c = (key % stride).astype(jnp.int32) + jnp.asarray(col_lo, jnp.int32)
+    pad = r >= nrows
+    return (jnp.where(pad, jnp.asarray(nrows, jnp.int32), r),
+            jnp.where(pad, jnp.asarray(ncols, jnp.int32), c))
+
+
+def _sort_compress_keyed(add: Monoid, key: Array, vals: Array, nlive: Array,
+                         *, nrows: int, ncols: int, cap: int, dedup: bool,
+                         stride: int, col_lo=0):
+    """`sort_compress` on pre-encoded fused keys: every sort carries one
+    key + one payload (num_keys=1), and rows/cols are materialized by a
+    single decode at the very end — over cap, not the (often much
+    larger) expansion length. Sentinel-keyed inputs must already carry
+    kmax; ``nlive`` is the non-sentinel count."""
+    vals, restore = _sortable(vals)
+    key, vals = lax.sort((key, vals), num_keys=1)
+    n = key.shape[0]
+    kmax = jnp.asarray((int(nrows) + 1) * int(stride) - 1, key.dtype)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    live = pos < nlive
+    if dedup:
+        same = key[1:] == key[:-1]
+        starts = jnp.concatenate([jnp.ones((1,), bool), ~same])
+        scanned = seg_scan_inclusive(add, vals, starts)
+        is_last = jnp.concatenate([~same, jnp.ones((1,), bool)])
+        nnz_full = jnp.sum(starts & live).astype(jnp.int32)
+        key = jnp.where(is_last & live, key, kmax)
+        key, vals = lax.sort((key, scanned), num_keys=1)
+    else:
+        nnz_full = nlive.astype(jnp.int32)
+    if cap >= n:
+        pad = cap - n
+        key = jnp.concatenate([key, jnp.full((pad,), kmax, key.dtype)])
+        vals = jnp.concatenate([vals, jnp.zeros((pad,), vals.dtype)])
+    else:
+        key, vals = key[:cap], vals[:cap]
+    nnz = jnp.minimum(nnz_full, cap)
+    vals = jnp.where(jnp.arange(cap, dtype=jnp.int32) < nnz, vals,
+                     jnp.zeros((), vals.dtype))
+    srows, scols = decode_key(key, nrows=nrows, ncols=ncols, stride=stride,
+                              col_lo=col_lo)
+    t = Tile(srows, scols, _unsortable(vals, restore), nnz, nrows, ncols)
+    return t, nnz_full
+
+
+def _sort_compress_2key(add: Monoid, srows: Array, scols: Array, vals: Array,
+                        nlive: Array, *, nrows: int, ncols: int, cap: int,
+                        dedup: bool = True):
+    """2-key reference implementation of `sort_compress` — the pre-fused
+    path, kept verbatim as the bit-exactness oracle and the fallback
+    when `fused_key_info` finds no dtype for the key space."""
     vals, restore = _sortable(vals)
     srows, scols, vals = lax.sort((srows, scols, vals), num_keys=2)
     n = srows.shape[0]
@@ -184,6 +304,35 @@ def sort_compress(add: Monoid, srows: Array, scols: Array, vals: Array,
                      jnp.zeros((), vals.dtype))
     t = Tile(srows, scols, _unsortable(vals, restore), nnz, nrows, ncols)
     return t, nnz_full
+
+
+def sort_compress(add: Monoid, srows: Array, scols: Array, vals: Array,
+                  nlive: Array, *, nrows: int, ncols: int, cap: int,
+                  dedup: bool = True):
+    """Shared COO→Tile compression: one sort (which compacts AND pads,
+    because invalid entries carry the (nrows, ncols) sentinel that is
+    also the padding convention), a segmented-scan dedup, and — only
+    when deduping — a second sort to re-compact the surviving group
+    tails. Inputs must already be sentinel-masked; ``nlive`` is the
+    number of non-sentinel entries. Returns (tile, live_group_count).
+
+    When the (nrows, ncols) key space fits an integer dtype the sorts
+    run on one fused row*stride+col key (`_sort_compress_keyed`) —
+    bit-exact vs the 2-key path because lax.sort is stable and the
+    fused key induces the identical (row, col) lexicographic order, so
+    both paths apply the identical permutation and combine duplicates
+    in the identical left-to-right order.
+    """
+    info = fused_key_info(nrows, ncols) if fused_keys_enabled() else None
+    if info is None:
+        return _sort_compress_2key(add, srows, scols, vals, nlive,
+                                   nrows=nrows, ncols=ncols, cap=cap,
+                                   dedup=dedup)
+    stride, kdt = info
+    key = encode_key(srows, scols, nrows=nrows, stride=stride, dtype=kdt)
+    return _sort_compress_keyed(add, key, vals, nlive, nrows=nrows,
+                                ncols=ncols, cap=cap, dedup=dedup,
+                                stride=stride)
 
 
 @partial(jax.jit, static_argnames=("add", "nrows", "ncols", "cap", "dedup",
@@ -253,7 +402,15 @@ def transpose(t: Tile) -> Tile:
     rows = jnp.where(v, t.cols, t.ncols)
     cols = jnp.where(v, t.rows, t.nrows)
     vals, restore = _sortable(t.vals)
-    rows, cols, vals = lax.sort((rows, cols, vals), num_keys=2)
+    info = fused_key_info(t.ncols, t.nrows) if fused_keys_enabled() else None
+    if info is None:
+        rows, cols, vals = lax.sort((rows, cols, vals), num_keys=2)
+    else:
+        stride, kdt = info
+        key = encode_key(rows, cols, nrows=t.ncols, stride=stride, dtype=kdt)
+        key, vals = lax.sort((key, vals), num_keys=1)
+        rows, cols = decode_key(key, nrows=t.ncols, ncols=t.nrows,
+                                stride=stride)
     return Tile(rows, cols, _unsortable(vals, restore), t.nnz,
                 t.ncols, t.nrows)
 
@@ -491,8 +648,16 @@ def col_structure(t: Tile):
     v = t.valid()
     sc = jnp.where(v, t.cols, t.ncols)
     srw = jnp.where(v, t.rows, t.nrows)
-    ccols, crows, order = lax.sort(
-        (sc, srw, jnp.arange(t.cap, dtype=jnp.int32)), num_keys=2)
+    arange = jnp.arange(t.cap, dtype=jnp.int32)
+    info = fused_key_info(t.ncols, t.nrows) if fused_keys_enabled() else None
+    if info is None:
+        ccols, crows, order = lax.sort((sc, srw, arange), num_keys=2)
+    else:
+        stride, kdt = info
+        key = encode_key(sc, srw, nrows=t.ncols, stride=stride, dtype=kdt)
+        key, order = lax.sort((key, arange), num_keys=1)
+        ccols, crows = decode_key(key, nrows=t.ncols, ncols=t.nrows,
+                                  stride=stride)
     cstarts = jnp.searchsorted(
         ccols, jnp.arange(t.ncols + 1, dtype=jnp.int32),
         side="left").astype(jnp.int32)
@@ -590,7 +755,12 @@ def _flops_cap_guard(flops_cap: int):
 
 def _esc2_expand(sr: Semiring, a: Tile, per: Array, base: Array, b: Tile,
                  flops_cap: int):
-    """Materialize the product expansion without per-slot A-side gathers.
+    """REFERENCE expansion: materialize the product expansion without
+    per-slot A-side gathers, in sequence layout, via three separate
+    copy-forward scans. This is the pre-fused bit-exactness oracle (and
+    the fallback when `fused_key_info` finds no key dtype); the
+    production path is `_expand_prep` + `_expand_finish_xla` / the
+    Pallas `fused_expand` kernel, which compute the same values.
 
     ``per[e]``/``base[e]``: product count and B-array start index for A
     entry e. Each A entry owns a contiguous run of slots; its row,
@@ -621,16 +791,171 @@ def _esc2_expand(sr: Semiring, a: Tile, per: Array, base: Array, b: Tile,
     return crow, ccol, cval, total
 
 
+def _expand_prep(a: Tile, per: Array, base: Array, flops_cap: int,
+                 nchunks: int = 128):
+    """Fused-expansion front end: scatter the per-A-entry run-start
+    channels (row, B-offset delta, A value, start flag) STRAIGHT into
+    the chunk-column (L, C) scan layout — one scatter per channel, no
+    `to_chunked` transposes — and seed every live column's top row.
+
+    Column-top seeding is what makes the downstream scan single-pass:
+    sequence position c*L (the top of chunk-column c) is owned by the A
+    entry whose run covers it (`searchsorted` on the inclusive flop
+    prefix); scattering that entry's channel values at flat offset c
+    with a set start flag makes every column's copy-forward scan
+    self-contained, so NO cross-column carry stitch is needed — the
+    property the Pallas kernel relies on to finish in one VMEM pass.
+    When a real run start coincides with a column top the duplicate
+    scatter writes provably equal values (the owner IS that entry), so
+    XLA's nondeterministic duplicate order is harmless.
+
+    Returns (rowv2, deltav2, avalv2, f2, total, L, restore) with the
+    (L, C) channel arrays, avalv2 in `_sortable` carrier form.
+    """
+    C = nchunks
+    L = -(-flops_cap // C)
+    incl = scan_inclusive(SATADD, per)
+    offs = incl - per                      # exclusive prefix
+    total = incl[-1]
+    live_e = (per > 0) & (offs < flops_cap)
+    tgt = jnp.where(live_e, chunked_pos(offs, flops_cap, C), L * C)
+    tops = jnp.arange(C, dtype=jnp.int32) * L      # column-top seq pos
+    own = jnp.clip(jnp.searchsorted(incl, tops, side="right"),
+                   0, per.shape[0] - 1).astype(jnp.int32)
+    ttgt = jnp.where(tops < jnp.minimum(total, flops_cap),
+                     jnp.arange(C, dtype=jnp.int32), L * C)
+    cat = jnp.concatenate([tgt, ttgt])
+
+    def scat(x):
+        src = jnp.concatenate([x, x[own]])
+        return jnp.zeros((L * C + 1,), x.dtype).at[cat].set(
+            src, mode="drop")[:L * C].reshape(L, C)
+
+    f2 = jnp.zeros((L * C + 1,), jnp.bool_).at[cat].set(
+        True, mode="drop")[:L * C].reshape(L, C)
+    avals, restore = _sortable(a.vals)
+    return (scat(a.rows), scat(base - offs), scat(avals), f2, total, L,
+            restore)
+
+
+def _propagate_multi(f2: Array, chans):
+    """One inclusive copy-forward scan over several channels sharing a
+    single start-flag array — replaces N independent `seg_propagate`
+    calls (each re-scanning the same flags) with one associative scan.
+    Columns must be self-contained (see `_expand_prep` seeding): no
+    cross-column stitch is applied."""
+    def op(a, b):
+        return (a[0] | b[0],) + tuple(
+            jnp.where(b[0], bx, ax) for ax, bx in zip(a[1:], b[1:]))
+    out = lax.associative_scan(op, (f2,) + tuple(chans), axis=0)
+    return out[1:]
+
+
+def _expand_finish_xla(sr: Semiring, b: Tile, rowv2: Array, deltav2: Array,
+                       avalv2: Array, f2: Array, restore, total: Array,
+                       L: int, flops_cap: int, nrows: int, stride: int,
+                       kdt, col_lo) -> tuple[Array, Array]:
+    """XLA back end of the fused expansion: one shared-flag multi-channel
+    scan, the two B-side gathers, the semiring multiply, and the fused
+    sort-key encode — emitted straight from the chunk-column layout.
+    Returns (key, cval) in sequence order, length flops_cap."""
+    C = f2.shape[1]
+    rowp, deltap, avalp = _propagate_multi(f2, (rowv2, deltav2, avalv2))
+    l = jnp.arange(L, dtype=jnp.int32)[:, None]
+    c = jnp.arange(C, dtype=jnp.int32)[None, :]
+    slot = c * L + l                       # sequence position of (l, c)
+    bidx = jnp.clip(deltap + slot, 0, b.cap - 1)
+    bcol = b.cols[bidx]
+    cval = sr.multiply(_unsortable(avalp, restore), b.vals[bidx])
+    live = (slot < total) & (slot < flops_cap)
+    kmax = jnp.asarray((int(nrows) + 1) * int(stride) - 1, kdt)
+    key = jnp.where(live,
+                    rowp.astype(kdt) * jnp.asarray(stride, kdt)
+                    + (bcol.astype(kdt) - jnp.asarray(col_lo, kdt)),
+                    kmax)
+    return key.T.reshape(-1)[:flops_cap], cval.T.reshape(-1)[:flops_cap]
+
+
+@functools.lru_cache(maxsize=None)
+def _widened_multiply(multiply, a_bool: bool, b_bool: bool):
+    """int32-in/int32-out view of a semiring multiply whose operands
+    ride int32 vregs in the Pallas expansion kernel (Mosaic has no
+    i1/i8 vector compute). Cached so the jitted kernel's static
+    ``multiply`` argument stays identical across calls."""
+    if not (a_bool or b_bool):
+        return multiply
+
+    def mult(av, bv):
+        out = multiply(av != 0 if a_bool else av,
+                       bv != 0 if b_bool else bv)
+        if out.dtype in (jnp.bool_, jnp.int8):
+            out = out.astype(jnp.int32)
+        return out
+    return mult
+
+
 def _esc2_finish(sr: Semiring, a: Tile, b: Tile, per: Array, base: Array,
-                 flops_cap: int, out_cap: int, dedup: bool) -> Tile:
-    crow, ccol, cval, total = _esc2_expand(sr, a, per, base, b, flops_cap)
-    live = jnp.arange(flops_cap, dtype=jnp.int32) < total
-    crow = jnp.where(live, crow, a.nrows)
-    ccol = jnp.where(live, ccol, b.ncols)
-    t, _ = sort_compress(sr.add, crow, ccol, cval,
-                         jnp.minimum(total, flops_cap),
-                         nrows=a.nrows, ncols=b.ncols, cap=out_cap,
-                         dedup=dedup)
+                 flops_cap: int, out_cap: int, dedup: bool, *,
+                 col_lo=None, key_width: Optional[int] = None) -> Tile:
+    """Expansion + compression tail shared by every SpGEMM entry point.
+
+    ``key_width``/``col_lo`` select the window-relative fused-key codec
+    (static width, traced base — spgemm_colwindow): keys are encoded as
+    row*(width+1) + (col - col_lo), which keeps the i32 single-key path
+    reachable for column windows of matrices whose full nrows*ncols
+    exceeds 2^31. Without them the whole-tile codec is used. When no
+    key dtype fits (`fused_key_info` -> None) or COMBBLAS_TPU_FUSED_KEY=0,
+    the pre-fused reference pipeline runs instead.
+    """
+    width = b.ncols if key_width is None else key_width
+    info = (fused_key_info(a.nrows, b.ncols, width=width)
+            if fused_keys_enabled() else None)
+    if info is None:
+        crow, ccol, cval, total = _esc2_expand(sr, a, per, base, b,
+                                               flops_cap)
+        live = jnp.arange(flops_cap, dtype=jnp.int32) < total
+        crow = jnp.where(live, crow, a.nrows)
+        ccol = jnp.where(live, ccol, b.ncols)
+        t, _ = _sort_compress_2key(sr.add, crow, ccol, cval,
+                                   jnp.minimum(total, flops_cap),
+                                   nrows=a.nrows, ncols=b.ncols,
+                                   cap=out_cap, dedup=dedup)
+        return t
+    stride, kdt = info
+    clo = jnp.zeros((), jnp.int32) if col_lo is None else col_lo
+    rowv2, deltav2, avalv2, f2, total, L, restore = _expand_prep(
+        a, per, base, flops_cap)
+    from combblas_tpu.ops import pallas_kernels as pk
+    if (pk.expand_enabled() and kdt == jnp.int32
+            and not pk.is_batched(per) and b.cap <= pk.EXPAND_BMAX):
+        a_bool = avalv2.dtype in (jnp.bool_, jnp.int8) and restore is not None
+        b_bool = b.dtype == jnp.bool_
+        widen_a = avalv2.dtype in (jnp.bool_, jnp.int8)
+        widen_b = b.dtype in (jnp.bool_, jnp.int8)
+        out_dtype = jax.eval_shape(
+            sr.multiply,
+            jax.ShapeDtypeStruct((), restore if restore is not None
+                                 else avalv2.dtype),
+            jax.ShapeDtypeStruct((), b.dtype)).dtype
+        key, cval = pk.fused_expand(
+            rowv2, deltav2,
+            avalv2.astype(jnp.int32) if widen_a else avalv2,
+            f2, b.cols,
+            b.vals.astype(jnp.int32) if widen_b else b.vals,
+            clo, total,
+            multiply=_widened_multiply(sr.multiply, a_bool, b_bool),
+            stride=stride, nrows=a.nrows, L=L, flops_cap=flops_cap,
+            interpret=pk.expand_interpret())
+        if cval.dtype != out_dtype:
+            cval = cval.astype(out_dtype)
+    else:
+        key, cval = _expand_finish_xla(sr, b, rowv2, deltav2, avalv2, f2,
+                                       restore, total, L, flops_cap,
+                                       a.nrows, stride, kdt, clo)
+    t, _ = _sort_compress_keyed(sr.add, key, cval,
+                                jnp.minimum(total, flops_cap),
+                                nrows=a.nrows, ncols=b.ncols, cap=out_cap,
+                                dedup=dedup, stride=stride, col_lo=clo)
     return t
 
 
@@ -725,10 +1050,12 @@ def spgemm_rowblock(sr: Semiring, a: Tile, b: Tile, bptr: Array, elo: Array,
     return _esc2_finish(sr, blk, b, per, base, flops_cap, out_cap, dedup)
 
 
-@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup"))
+@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup",
+                                   "win_width"))
 def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
-                     *, flops_cap: int, out_cap: int,
-                     dedup: bool = True) -> Tile:
+                     *, flops_cap: int, out_cap: int, dedup: bool = True,
+                     win_width: Optional[int] = None,
+                     b_struct=None) -> Tile:
     """c = a ⊗ B[:, clo:chi) with *dynamic* (traced) column bounds —
     the local body of single-tile phased SpGEMM (≅ MemEfficientSpGEMM's
     ColSplit windows, ParFriends.h:555), without materializing the B
@@ -737,6 +1064,13 @@ def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
     offsets come from two segmented reductions over B. Because clo/chi
     are traced, every phase with the same cap buckets reuses ONE
     compiled kernel. Output columns keep their global indices.
+
+    ``win_width`` (static, >= chi-clo for every window in a plan)
+    switches the ESC tail onto the window-relative fused-key codec —
+    i32 single-key sorts even when nrows*ncols overflows 2^31 (the MCL
+    hot loop's case). ``b_struct`` = (row_structure(b) + (row_starts(b),))
+    hoists the window-independent B metadata out of the per-window call
+    (it was recomputed from all of B every window otherwise).
     """
     assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
     _flops_cap_guard(flops_cap)
@@ -744,12 +1078,17 @@ def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
     v = b.valid()
     inwin = (v & (b.cols >= clo) & (b.cols < chi)).astype(jnp.int32)
     before = (v & (b.cols < clo)).astype(jnp.int32)
-    starts_b, seg_ends, nonempty = row_structure(b)
+    if b_struct is None:
+        starts_b, seg_ends, nonempty = row_structure(b)
+        bptr = row_starts(b)
+    else:
+        starts_b, seg_ends, nonempty, bptr = b_struct
     cnt_w = seg_reduce_sorted(PLUS, inwin, starts_b, seg_ends, nonempty)
     n_before = seg_reduce_sorted(PLUS, before, starts_b, seg_ends, nonempty)
-    bptr = row_starts(b)
     bstart_w = bptr[:-1] + n_before
     acol = jnp.clip(a.cols, 0, a.ncols - 1)
     per = jnp.where(a.valid(), cnt_w[acol], 0)
     base = bstart_w[acol]
-    return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup)
+    return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup,
+                        col_lo=clo if win_width is not None else None,
+                        key_width=win_width)

@@ -367,6 +367,26 @@ class CapLadder:
         _M_LADDER.inc(kind="miss")
         return rung
 
+    def save(self, path: str) -> None:
+        """Serialize the minted rungs to JSON: a later run (or process)
+        that `load`s them never mints — every `fit` is a hit, so the
+        warm run re-traces zero SUMMA shapes (each miss is a likely
+        recompile per the `spgemm.capladder` metric)."""
+        import json
+        with open(path, "w") as f:
+            json.dump({"slack": self.slack, "floor": self.floor,
+                       "rungs": sorted(int(r) for r in self.rungs)}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "CapLadder":
+        import json
+        with open(path) as f:
+            d = json.load(f)
+        lad = cls(slack=float(d.get("slack", 8.0)),
+                  floor=int(d.get("floor", 4096)))
+        lad.rungs = sorted(int(r) for r in d.get("rungs", []))
+        return lad
+
 
 def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
                     phases: Optional[int] = None,
@@ -431,6 +451,24 @@ def _place3(dr, dc, dv, off, sr_, sc_, sv_):
             lax.dynamic_update_slice(dv, sv_, (off,)))
 
 
+@partial(jax.jit, static_argnames=("new_cap",), donate_argnums=(0,))
+def _shrink_tile(t: tl.Tile, *, new_cap: int) -> tl.Tile:
+    """Donated capacity change: the window result's flops-sized buffers
+    are released the moment the live prefix is copied out, instead of
+    surviving until Python drops the reference — the difference between
+    fitting and OOMing two in-flight windows under the 16 GB ceiling."""
+    return t.with_capacity(new_cap)
+
+
+@partial(jax.jit, static_argnames=("grow", "nrows", "ncols"),
+         donate_argnums=(0, 1, 2))
+def _grow3(dr, dc, dv, *, grow: int, nrows: int, ncols: int):
+    """Donated accumulator growth (sentinel-padded tail)."""
+    return (jnp.concatenate([dr, jnp.full((grow,), nrows, jnp.int32)]),
+            jnp.concatenate([dc, jnp.full((grow,), ncols, jnp.int32)]),
+            jnp.concatenate([dv, jnp.zeros((grow,), dv.dtype)]))
+
+
 def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                 phases: Optional[int], phase_flop_budget: int,
                 prune_hook, out_cap: Optional[int],
@@ -469,6 +507,15 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                                   phase_flop_budget=phase_flop_budget,
                                   cap_round=cap_round,
                                   cap_ladder=cap_ladder)
+        # static window width (>= every chi-clo, bucketed so iterated
+        # pipelines reuse the compiled kernel): window-relative fused
+        # sort keys fit i32 even when nrows*ncols overflows 2^31
+        wmax = max((hi - lo for lo, hi, _, _ in windows), default=1)
+        win_width = min(fit(wmax, 128), bt.ncols)
+        # window-independent B metadata, hoisted: the per-window kernel
+        # previously recomputed row_structure(b) + row_starts(b) — two
+        # full passes over B's cap — inside EVERY window call
+        b_struct = tl.row_structure(bt) + (tl.row_starts(bt),)
 
     def wrap(t: tl.Tile) -> DistSpMat:
         return DistSpMat(t.rows[None, None], t.cols[None, None],
@@ -483,7 +530,8 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             with obs.span("local", category="device_execute"):
                 cp = tl.spgemm_colwindow(
                     sr, at, bt, jnp.asarray(lo, jnp.int32),
-                    jnp.asarray(hi, jnp.int32), flops_cap=fc, out_cap=oc)
+                    jnp.asarray(hi, jnp.int32), flops_cap=fc, out_cap=oc,
+                    win_width=win_width, b_struct=b_struct)
                 obs.sync(cp.rows)
             if prune_hook is not None:
                 with obs.span("prune", category="device_execute"):
@@ -497,7 +545,7 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             with obs.span("nnz_readback", category="host_readback"):
                 pn = int(np.asarray(cp.nnz))
             with obs.span("place", category="device_execute"):
-                cp = cp.with_capacity(fit(pn, 128))
+                cp = _shrink_tile(cp, new_cap=fit(pn, 128))
                 need_buf = nlive + cp.cap  # placement writes cp's padding
                 if acc is None:
                     ac_cap = fit(need_buf, cap_round)
@@ -508,15 +556,8 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                     # geometric growth keeps total copy work O(final size)
                     ac_cap = fit(max(need_buf, 2 * acc[0].shape[0]),
                                  cap_round)
-                    grow = ac_cap - acc[0].shape[0]
-                    acc = (jnp.concatenate(
-                               [acc[0],
-                                jnp.full((grow,), a.tile_m, jnp.int32)]),
-                           jnp.concatenate(
-                               [acc[1],
-                                jnp.full((grow,), b.tile_n, jnp.int32)]),
-                           jnp.concatenate(
-                               [acc[2], jnp.zeros((grow,), acc[2].dtype)]))
+                    acc = _grow3(*acc, grow=ac_cap - acc[0].shape[0],
+                                 nrows=a.tile_m, ncols=b.tile_n)
                 acc = _place3(*acc, jnp.int32(nlive),
                               cp.rows, cp.cols, cp.vals)
                 nlive += pn

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""ESC SpGEMM microbench: ns/slot + HLO pass accounting, before/after
+the fused-key rework -> ESC_MICROBENCH.json.
+
+Three device-side pipeline variants of the SAME jitted `tile.spgemm`:
+
+  2key        COMBBLAS_TPU_FUSED_KEY=0 — the pre-rework reference:
+              2-key lexicographic sorts (row, col, payload), 3
+              seg_propagate scans in the expansion;
+  fused_xla   fused single-key sorts (key, payload) + the XLA fused
+              expansion (shared-flag multi-channel scan, column-top
+              seeded — no cross-column stitch);
+  fused_pallas  the Pallas fused-expansion kernel in front of the same
+              keyed sorts (COMBBLAS_TPU_PALLAS_EXPAND=1; skipped unless
+              a TPU is attached — interpret mode measures nothing).
+
+Per variant: per-slot wall time (median of --reps dispatch-synced
+runs over the identical tile and flops_cap, so ns/slot divides by the
+SAME denominator) and the structural pass accounting from the
+unoptimized StableHLO (sort ops, total sorted operands, gathers,
+scatters) — the ns/slot claim and the pass-count claim travel
+together, per-variant, in one artifact. bench.py-style output: every
+variant prints its own JSON line; the LAST line is the headline
+{"metric": "esc_ns_per_slot", ...} with the before/after ratio.
+
+Usage: esc_microbench.py [--scale 14] [--reps 7] [--budget-log2 22]
+                         [--out ESC_MICROBENCH.json]
+"""
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=int, default=14,
+                    help="R-MAT scale of the operand tile")
+    ap.add_argument("--edgefactor", type=int, default=16)
+    ap.add_argument("--budget-log2", type=int, default=22,
+                    help="flops_cap = 2^this (every variant shares it)")
+    ap.add_argument("--reps", type=int, default=7)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ESC_MICROBENCH.json"))
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu.ops import pallas_kernels as pk
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.ops import tile as tl
+    from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    platform = jax.devices()[0].platform
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    a = dm.from_rmat(S.LOR, grid, jax.random.key(1), args.scale,
+                     args.edgefactor, val_dtype=jnp.bool_)
+    a = a.astype(jnp.float32)
+    at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
+                 a.tile_m, a.tile_n)
+    flops_cap = 1 << args.budget_log2
+    out_cap = flops_cap // 2
+    total_flops = tl.spgemm_flops(at, at)
+    print(f"# scale={args.scale} nnz={int(at.nnz)} total_flops="
+          f"{total_flops} flops_cap={flops_cap} platform={platform}",
+          file=sys.stderr, flush=True)
+
+    def run(at):
+        # dedup=True: the full ESC tail incl. the re-sort under audit
+        return tl.spgemm(S.PLUS_TIMES_F32, at, at,
+                         flops_cap=flops_cap, out_cap=out_cap)
+
+    def hlo_passes():
+        txt = jax.jit(run).lower(at).as_text()
+        arities = [m.group(1).count("%") for m in
+                   re.finditer(r'"stablehlo\.sort"\(([^)]*)\)', txt)]
+        return {"sort_ops": len(arities),
+                "sorted_operands": sum(arities),
+                "gathers": len(re.findall(r'stablehlo\.gather"', txt)),
+                "scatters": len(re.findall(r'stablehlo\.scatter"', txt))}
+
+    def measure(name, env):
+        for k, v in env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        jax.clear_caches()                     # env is read at trace time
+        passes = hlo_passes()
+        c = run(at)
+        jax.block_until_ready(c.vals)          # compile + warm up
+        nnz = int(np.asarray(c.nnz))
+        times = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            c = run(at)
+            jax.block_until_ready(c.vals)
+            times.append(time.perf_counter() - t0)
+        med = float(np.median(times))
+        rec = {"variant": name, "seconds_median": round(med, 6),
+               "seconds_min": round(min(times), 6), "reps": args.reps,
+               "ns_per_slot": round(med / flops_cap * 1e9, 3),
+               "c_nnz": nnz, "passes": passes}
+        print(json.dumps(rec), flush=True)
+        return rec
+
+    variants = [("2key", {"COMBBLAS_TPU_FUSED_KEY": "0",
+                          "COMBBLAS_TPU_PALLAS_EXPAND": None}),
+                ("fused_xla", {"COMBBLAS_TPU_FUSED_KEY": None,
+                               "COMBBLAS_TPU_PALLAS_EXPAND": None})]
+    if platform == "tpu":
+        variants.append(("fused_pallas",
+                         {"COMBBLAS_TPU_FUSED_KEY": None,
+                          "COMBBLAS_TPU_PALLAS_EXPAND": "1"}))
+    else:
+        print("# fused_pallas skipped: no TPU attached (interpret mode "
+              "measures the emulator, not the kernel)", file=sys.stderr,
+              flush=True)
+    recs = {name: measure(name, env) for name, env in variants}
+    for k in ("COMBBLAS_TPU_FUSED_KEY", "COMBBLAS_TPU_PALLAS_EXPAND"):
+        os.environ.pop(k, None)
+
+    before = recs["2key"]
+    after = recs.get("fused_pallas", recs["fused_xla"])
+    headline = {
+        "metric": "esc_ns_per_slot",
+        "value": after["ns_per_slot"], "unit": "ns/slot",
+        "before_ns_per_slot": before["ns_per_slot"],
+        "speedup": round(before["seconds_median"]
+                         / after["seconds_median"], 3),
+        "after_variant": after["variant"],
+        "platform": platform, "scale": args.scale,
+        "flops_cap": flops_cap, "variants": recs,
+        "note": "median wall time of the full jitted ESC SpGEMM "
+                "(expand + sort + dedup + re-sort) divided by flops_cap; "
+                "every variant runs the identical tile and flops_cap, "
+                "so ns/slot divides by the same denominator. `passes` "
+                "counts structural ops in the unoptimized StableHLO "
+                "(tests/test_hlo_passes.py pins them).",
+    }
+    line = json.dumps(headline)
+    print(line)
+    if args.out and args.out != "0":
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -23,7 +23,9 @@ from combblas_tpu import obs
 from combblas_tpu.ops import semiring as S
 from combblas_tpu.models import mcl as M
 from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import spgemm as spg
 from combblas_tpu.parallel.grid import ProcGrid
+from combblas_tpu.utils.config import setup_compilation_cache
 
 
 def planted_partition(n, nclust, seed, intra_deg=16, bg_deg=2):
@@ -59,6 +61,25 @@ def main():
     n = 1 << scale
     nclust = max(2, n // 64)
 
+    # warm-start plumbing: a persistent XLA compile cache plus the
+    # previous run's CapLadder rungs — together a repeat run mints no
+    # rungs AND loads every kernel from disk instead of recompiling
+    # (the ~40 min of relay compiles in iterations 1-2 at n=65536)
+    cache_dir = setup_compilation_cache()
+    if cache_dir:
+        print(f"# compile cache: {cache_dir}", file=sys.stderr, flush=True)
+    ladder_path = os.environ.get("COMBBLAS_TPU_LADDER", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        f"MCL_LADDER_s{scale}.json"))
+    ladder = None
+    if ladder_path and ladder_path != "0":
+        if os.path.exists(ladder_path):
+            ladder = spg.CapLadder.load(ladder_path)
+            print(f"# ladder: {len(ladder.rungs)} rungs from {ladder_path}",
+                  file=sys.stderr, flush=True)
+        else:
+            ladder = spg.CapLadder()
+
     grid = ProcGrid.make(1, 1, jax.devices()[:1])
     r, c, members = planted_partition(n, nclust, seed=1)
     a = dm.from_global_coo(S.PLUS, grid, jnp.asarray(r), jnp.asarray(c),
@@ -73,10 +94,14 @@ def main():
     t0 = time.perf_counter()
     labels, ncl, iters = M.mcl(
         a, M.MclParams(max_iters=max_iters, phase_flop_budget=budget),
-        verbose=True)
+        verbose=True, cap_ladder=ladder)
     jax.block_until_ready(labels.data)
     dt = time.perf_counter() - t0
     obs.set_enabled(False)
+    if ladder is not None and ladder_path and ladder_path != "0":
+        ladder.save(ladder_path)
+        print(f"# ladder: {len(ladder.rungs)} rungs -> {ladder_path}",
+              file=sys.stderr, flush=True)
     breakdown = obs.export.phase_breakdown()
     print(obs.export.format_report(min_s=0.01), file=sys.stderr, flush=True)
 

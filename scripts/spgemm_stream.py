@@ -36,6 +36,7 @@ from combblas_tpu.ops import tile as tl
 from combblas_tpu.parallel import distmat as dm
 from combblas_tpu.parallel import spgemm as spg
 from combblas_tpu.parallel.grid import ProcGrid
+from combblas_tpu.utils.config import setup_compilation_cache
 
 
 def _rowflops_int64(at: tl.Tile, _force_slice_len=None):
@@ -125,6 +126,9 @@ def main():
     budget = 1 << (int(sys.argv[3]) if len(sys.argv) > 3 else 26)
     mode = sys.argv[4] if len(sys.argv) > 4 else "rows"
 
+    cache_dir = setup_compilation_cache()
+    if cache_dir:
+        print(f"# compile cache: {cache_dir}", file=sys.stderr, flush=True)
     grid = ProcGrid.make(1, 1, jax.devices()[:1])
     t0 = time.perf_counter()
     # build the R-MAT pattern as bool (LOR dedup) and cast to f32 for
@@ -161,12 +165,19 @@ def main():
         caps = [oc] * nblocks
     else:
         windows = spg.plan_colwindows(a, a, phase_flop_budget=budget)
+        # static window width + hoisted B metadata: the window-relative
+        # i32 fused-key codec applies even at scales where nrows*ncols
+        # overflows 2^31, and row_structure/row_starts leave the loop
+        wmax = max((hi - lo for lo, hi, _, _ in windows), default=1)
+        win_width = min(spg._bucket_fine(wmax, 128), at.ncols)
+        b_struct = tl.row_structure(at) + (tl.row_starts(at),)
 
         def run_block(i):
             lo, hi, fc, oc = windows[i]
             return tl.spgemm_colwindow(
                 S.PLUS_TIMES_F32, at, at, jnp.int32(lo), jnp.int32(hi),
-                flops_cap=fc, out_cap=oc)
+                flops_cap=fc, out_cap=oc, win_width=win_width,
+                b_struct=b_struct)
         nblocks = len(windows)
         caps = [w[3] for w in windows]
 

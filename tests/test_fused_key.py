@@ -1,0 +1,167 @@
+"""Fused single-key sort codec (ops/tile.py): dtype selection around
+the 2^31 sentinel boundary, padding-sentinel ordering, encode/decode
+round trip, and bit-exactness of the keyed sort_compress against the
+2-key reference path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile as T
+
+pytestmark = pytest.mark.quick
+
+
+class TestDtypeSelection:
+    def test_i32_boundary_exact(self):
+        # the codec must hold kmax = (nrows+1)*stride - 1, not just the
+        # largest live key: the selection boundary is exactly where the
+        # SENTINEL (not nrows*ncols) crosses 2^31-1
+        ncols = 2 ** 15
+        stride = ncols + 1
+        nrows_max = (2 ** 31) // stride - 1   # largest with kmax <= 2^31-1
+        info = T.fused_key_info(nrows_max, ncols)
+        assert info is not None and info == (stride, jnp.int32)
+        assert (nrows_max + 1) * stride - 1 <= 2 ** 31 - 1
+        # one row more and the sentinel overflows i32: no dtype (x64 is
+        # disabled in the suite), so callers fall back to 2-key sorts
+        assert T.fused_key_info(nrows_max + 1, ncols) is None
+        assert ((nrows_max + 2) * stride - 1) > 2 ** 31 - 1
+
+    def test_i64_only_under_x64(self):
+        big = 1 << 20                          # kmax ~ 2^40: needs i64
+        assert T.fused_key_info(big, big) is None
+        jax.config.update("jax_enable_x64", True)
+        try:
+            assert T.fused_key_info(big, big) == (big + 1, jnp.int64)
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_window_width_restores_i32(self):
+        # whole-tile key space overflows i32, but a 128-wide window's
+        # window-relative codec fits — the spgemm_colwindow case
+        n = 1 << 20
+        assert T.fused_key_info(n, n) is None
+        assert T.fused_key_info(n, n, width=128) == (129, jnp.int32)
+
+    def test_env_opt_out(self, monkeypatch):
+        monkeypatch.setenv("COMBBLAS_TPU_FUSED_KEY", "0")
+        assert not T.fused_keys_enabled()
+        monkeypatch.setenv("COMBBLAS_TPU_FUSED_KEY", "1")
+        assert T.fused_keys_enabled()
+
+
+class TestCodec:
+    def test_sentinel_sorts_last(self, rng):
+        nrows, ncols = 1000, 700
+        stride, kdt = T.fused_key_info(nrows, ncols)
+        r = jnp.asarray(rng.integers(0, nrows, 256), jnp.int32)
+        c = jnp.asarray(rng.integers(0, ncols, 256), jnp.int32)
+        # sentinel convention: padding rows carry row == nrows with
+        # arbitrary (even out-of-range) cols
+        r = r.at[100:140].set(nrows)
+        k = np.asarray(T.encode_key(r, c, nrows=nrows, stride=stride,
+                                    dtype=kdt))
+        kmax = (nrows + 1) * stride - 1
+        assert (k[100:140] == kmax).all()
+        live = np.concatenate([k[:100], k[140:]])
+        assert (live < kmax).all()
+
+    def test_round_trip_identity(self, rng):
+        nrows, ncols = 513, 1023
+        stride, kdt = T.fused_key_info(nrows, ncols)
+        r = jnp.asarray(rng.integers(0, nrows, 512), jnp.int32)
+        c = jnp.asarray(rng.integers(0, ncols, 512), jnp.int32)
+        k = T.encode_key(r, c, nrows=nrows, stride=stride, dtype=kdt)
+        r2, c2 = T.decode_key(k, nrows=nrows, ncols=ncols, stride=stride)
+        np.testing.assert_array_equal(np.asarray(r2), np.asarray(r))
+        np.testing.assert_array_equal(np.asarray(c2), np.asarray(c))
+
+    def test_round_trip_window_relative(self, rng):
+        # window codec: static width, traced col_lo; decode restores
+        # GLOBAL columns and canonicalizes sentinels to (nrows, ncols)
+        nrows, ncols, width, col_lo = 1 << 20, 1 << 20, 128, 9000
+        stride, kdt = T.fused_key_info(nrows, ncols, width=width)
+        r = jnp.asarray(rng.integers(0, nrows, 300), jnp.int32)
+        c = jnp.asarray(rng.integers(col_lo, col_lo + width, 300), jnp.int32)
+        r = r.at[:17].set(nrows)               # padding
+        k = T.encode_key(r, c, nrows=nrows, stride=stride, dtype=kdt,
+                         col_lo=col_lo)
+        r2, c2 = T.decode_key(k, nrows=nrows, ncols=ncols, stride=stride,
+                              col_lo=col_lo)
+        np.testing.assert_array_equal(np.asarray(r2[:17]),
+                                      np.full(17, nrows, np.int32))
+        np.testing.assert_array_equal(np.asarray(c2[:17]),
+                                      np.full(17, ncols, np.int32))
+        np.testing.assert_array_equal(np.asarray(r2[17:]),
+                                      np.asarray(r[17:]))
+        np.testing.assert_array_equal(np.asarray(c2[17:]),
+                                      np.asarray(c[17:]))
+
+    def test_key_order_is_lexicographic(self, rng):
+        nrows, ncols = 211, 307
+        stride, kdt = T.fused_key_info(nrows, ncols)
+        r = rng.integers(0, nrows, 400).astype(np.int64)
+        c = rng.integers(0, ncols, 400).astype(np.int64)
+        k = np.asarray(T.encode_key(jnp.asarray(r, jnp.int32),
+                                    jnp.asarray(c, jnp.int32),
+                                    nrows=nrows, stride=stride, dtype=kdt))
+        # the fused key induces the identical order as (row, col) lex —
+        # the property the sort_compress bit-exactness proof rests on
+        lex = np.lexsort((c, r))
+        np.testing.assert_array_equal(np.argsort(k, kind="stable"), lex)
+
+
+class TestSortCompressParity:
+    def _coo(self, rng, nrows, ncols, n, dup_frac=0.4):
+        r = rng.integers(0, nrows, n).astype(np.int32)
+        c = rng.integers(0, ncols, n).astype(np.int32)
+        ndup = int(n * dup_frac)
+        r[:ndup] = r[n - ndup:]                # force duplicate keys
+        c[:ndup] = c[n - ndup:]
+        v = rng.standard_normal(n).astype(np.float32)
+        return jnp.asarray(r), jnp.asarray(c), jnp.asarray(v)
+
+    @pytest.mark.parametrize("dedup", [True, False])
+    @pytest.mark.parametrize("cap", [64, 600])
+    def test_keyed_matches_2key(self, rng, dedup, cap):
+        nrows, ncols, n = 37, 53, 500
+        r, c, v = self._coo(rng, nrows, ncols, n)
+        nlive = jnp.asarray(430, jnp.int32)
+        # sentinel-mask the dead tail, as sort_compress's contract asks
+        dead = jnp.arange(n) >= 430
+        r = jnp.where(dead, nrows, r)
+        c = jnp.where(dead, ncols, c)
+        stride, kdt = T.fused_key_info(nrows, ncols)
+        key = T.encode_key(r, c, nrows=nrows, stride=stride, dtype=kdt)
+        t1, n1 = T._sort_compress_keyed(S.PLUS, key, v, nlive, nrows=nrows,
+                                        ncols=ncols, cap=cap, dedup=dedup,
+                                        stride=stride)
+        t2, n2 = T._sort_compress_2key(S.PLUS, r, c, v, nlive, nrows=nrows,
+                                       ncols=ncols, cap=cap, dedup=dedup)
+        assert int(n1) == int(n2)
+        assert int(t1.nnz) == int(t2.nnz)
+        np.testing.assert_array_equal(np.asarray(t1.rows), np.asarray(t2.rows))
+        np.testing.assert_array_equal(np.asarray(t1.cols), np.asarray(t2.cols))
+        # bit-exact: both paths apply the identical stable permutation,
+        # so float duplicate-combine order is identical
+        np.testing.assert_array_equal(np.asarray(t1.vals), np.asarray(t2.vals))
+
+    def test_from_coo_env_paths_bit_exact(self, rng, monkeypatch):
+        # the public entry under both env settings, via fresh traces
+        nrows, ncols, n = 41, 47, 300
+        r, c, v = self._coo(rng, nrows, ncols, n)
+        outs = {}
+        for env in ("1", "0"):
+            monkeypatch.setenv("COMBBLAS_TPU_FUSED_KEY", env)
+            jax.clear_caches()                 # env is read at trace time
+            t = T.from_coo(S.PLUS, r, c, v, nrows=nrows, ncols=ncols,
+                           cap=256)
+            outs[env] = (np.asarray(t.rows), np.asarray(t.cols),
+                         np.asarray(t.vals), int(t.nnz))
+        monkeypatch.delenv("COMBBLAS_TPU_FUSED_KEY")
+        jax.clear_caches()
+        for a, b in zip(outs["1"], outs["0"]):
+            np.testing.assert_array_equal(a, b)
