@@ -634,6 +634,70 @@ def _bfs_loop(plan, grid, tile_n, tiers, branches, parents0,
 
 
 # ---------------------------------------------------------------------------
+# Batched multi-source BFS (the serve batcher's device kernel)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def bfs_batch(a: dm.DistSpMat, roots, max_levels=None):
+    """W simultaneous BFS traversals in ONE jitted while_loop: the
+    frontiers ride the columns of a `DistMultiVec` and every level is
+    one `spmm` with the select2nd-max semiring (≅ BetwCent's batch-of-
+    roots framing, BetwCent.cpp:146; the tall-and-skinny multiply of
+    arXiv:2408.11988).
+
+    Bit-exact vs per-root `bfs`: per level the dense stepper computes
+    y[i] = max over active in-neighbors j of the global column id — and
+    `spmm(SELECT2ND_MAX_I32, a, x)` with x[j, w] = (act ? global col
+    id : MAX-identity) is that exact reduction, column-wise. Columns
+    are independent, so duplicate roots are just repeated columns.
+
+    ``max_levels`` (dynamic int32, no recompile per value; None/0 =
+    unbounded) caps the number of levels — the serve engine's deadline
+    degradation: expired requests return the partial parents computed
+    so far. Returns (parents r-aligned DistMultiVec, levels run,
+    done (W,) bool — False where the traversal was truncated)."""
+    from combblas_tpu.parallel import densemat as dmm
+    grid = a.grid
+    tile_m, tile_n = a.tile_m, a.tile_n
+    roots = jnp.asarray(roots, jnp.int32)
+    w = roots.shape[0]
+    w_ix = jnp.arange(w, dtype=jnp.int32)
+    parents0 = jnp.full((grid.pr, tile_m, w), NO_PARENT, jnp.int32)
+    parents0 = parents0.at[roots // tile_m, roots % tile_m, w_ix].set(roots)
+    act0 = jnp.zeros((grid.pc, tile_n, w), bool)
+    act0 = act0.at[roots // tile_n, roots % tile_n, w_ix].set(True)
+    if max_levels is None:
+        ml = jnp.int32(_SAT)
+    else:
+        ml = jnp.asarray(max_levels, jnp.int32)
+        ml = jnp.where(ml <= 0, jnp.int32(_SAT), ml)
+    gcol = (jnp.arange(grid.pc, dtype=jnp.int32)[:, None] * tile_n
+            + jnp.arange(tile_n, dtype=jnp.int32)[None, :])
+
+    def cond(carry):
+        _, act, lvl = carry
+        return jnp.any(act) & (lvl < ml)
+
+    def body(carry):
+        parents, act, lvl = carry
+        x = dmm.DistMultiVec(
+            jnp.where(act, gcol[:, :, None], _IDENT), grid, COL_AXIS,
+            a.ncols)
+        y = dmm.spmm(S.SELECT2ND_MAX_I32, a, x)
+        fresh = (y.data != _IDENT) & (parents == NO_PARENT)
+        parents = jnp.where(fresh, y.data, parents)
+        actn = dmm.mv_realign(
+            dmm.DistMultiVec(fresh, grid, ROW_AXIS, a.nrows),
+            COL_AXIS, block=tile_n, fill=False).data
+        return parents, actn, lvl + 1
+
+    parents, act, lvl = lax.while_loop(cond, body,
+                                       (parents0, act0, jnp.int32(0)))
+    done = ~jnp.any(act, axis=(0, 1))
+    return (dmm.DistMultiVec(parents, grid, ROW_AXIS, a.nrows), lvl, done)
+
+
+# ---------------------------------------------------------------------------
 # Validation + statistics (≅ TopDownBFS.cpp:452-524)
 # ---------------------------------------------------------------------------
 

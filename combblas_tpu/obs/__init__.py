@@ -34,5 +34,5 @@ from combblas_tpu.obs.trace import (
 from combblas_tpu.obs.metrics import REGISTRY, counter, gauge, histogram
 from combblas_tpu.obs.export import (
     chrome_trace, format_report, phase_breakdown, profiler_trace, report,
-    to_jsonl,
+    read_jsonl, read_jsonl_metrics, to_jsonl,
 )

@@ -106,19 +106,57 @@ def format_report(tracer: Tracer | None = None, indent: int = 2,
     for k, v in sorted(bd.items(), key=lambda kv: -kv[1]):
         pct = 100.0 * v / total if total else 0.0
         lines.append(f"  {k:<42} {v:>10.4f} {pct:>9.1f}%")
+    hist_lines = _histogram_lines()
+    if hist_lines:
+        lines.append(f"{'-- histograms --':<44} "
+                     f"{'count':>10} {'p50':>10} {'p90':>10} {'p99':>10}")
+        lines.extend(hist_lines)
     return "\n".join(lines)
+
+
+def _fmt_q(v) -> str:
+    return "-" if v is None else f"{v:.4g}"
+
+
+def _histogram_lines() -> list[str]:
+    """One line per histogram series in the registry: count + p50/p90/
+    p99 from the bounded sample window (metrics.Histogram)."""
+    from combblas_tpu.obs import metrics as _metrics
+    lines = []
+    for name, snap in sorted(_metrics.REGISTRY.snapshot().items()):
+        if snap["type"] != "histogram":
+            continue
+        for s in snap["series"]:
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted(s["labels"].items()))
+            label = f"{name}{{{labels}}}" if labels else name
+            lines.append(
+                f"  {label:<42} {s['count']:>10} "
+                f"{_fmt_q(s['p50']):>10} {_fmt_q(s['p90']):>10} "
+                f"{_fmt_q(s['p99']):>10}")
+    return lines
 
 
 # ---------------------------------------------------------------------------
 # JSON-lines event log (round-trippable)
 # ---------------------------------------------------------------------------
 
-def to_jsonl(path, tracer: Tracer | None = None) -> int:
-    """One JSON object per completed span; returns the record count."""
+def to_jsonl(path, tracer: Tracer | None = None,
+             include_metrics: bool = True) -> int:
+    """One JSON object per completed span; returns the record count.
+    A trailing `{"type": "metrics", ...}` line carries the registry
+    snapshot (counters/gauges/histograms incl. p50/p90/p99) when it is
+    non-empty — `read_jsonl` skips it, so span round-trips hold."""
     recs = _records(tracer)
     with open(path, "w") as f:
         for r in recs:
             f.write(json.dumps(r.to_dict()) + "\n")
+        if include_metrics:
+            from combblas_tpu.obs import metrics as _metrics
+            snap = _metrics.REGISTRY.snapshot()
+            if snap:
+                f.write(json.dumps({"type": "metrics",
+                                    "metrics": snap}) + "\n")
     return len(recs)
 
 
@@ -129,10 +167,24 @@ def read_jsonl(path) -> list[SpanRecord]:
             if not line.strip():
                 continue
             d = json.loads(line)
+            if "type" in d:      # metrics (or other non-span) line
+                continue
             out.append(SpanRecord(
                 d["name"], d["category"], d["t0"], d["t1"], d["depth"],
                 tuple(d["path"]), d["tid"], d["attrs"], d["children_s"]))
     return out
+
+
+def read_jsonl_metrics(path) -> dict | None:
+    """The registry snapshot embedded by `to_jsonl`, or None."""
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            d = json.loads(line)
+            if d.get("type") == "metrics":
+                return d["metrics"]
+    return None
 
 
 # ---------------------------------------------------------------------------

@@ -10,11 +10,19 @@ Gated on the same process-wide flag as spans (`trace.set_enabled`):
 disabled updates are one flag check. Registration itself is always
 allowed (module-level handles are cheap and keep hot loops free of
 dict lookups).
+
+Thread-safety contract (the serve workers emit from multiple threads):
+every mutation AND every read of a metric's series dict happens under
+that metric's lock — snapshots copy under the lock and then format
+outside it, so a concurrent `observe` can never tear an iteration.
+The registry's name->metric map is likewise locked. (Span stacks are
+per-thread already — `trace.Tracer` keeps them in `threading.local`.)
 """
 
 from __future__ import annotations
 
 import bisect
+import math
 import threading
 
 from combblas_tpu.obs import trace as _trace
@@ -43,12 +51,15 @@ class Counter:
             self._series[k] = self._series.get(k, 0) + value
 
     def value(self, **labels) -> float:
-        return self._series.get(_key(labels), 0)
+        with self._lock:
+            return self._series.get(_key(labels), 0)
 
     def snapshot(self) -> dict:
+        with self._lock:
+            items = sorted(self._series.items())
         return {"type": "counter", "help": self.help,
                 "series": [{"labels": dict(k), "value": v}
-                           for k, v in sorted(self._series.items())]}
+                           for k, v in items]}
 
     def reset(self) -> None:
         with self._lock:
@@ -71,12 +82,15 @@ class Gauge:
             self._series[_key(labels)] = value
 
     def value(self, **labels):
-        return self._series.get(_key(labels))
+        with self._lock:
+            return self._series.get(_key(labels))
 
     def snapshot(self) -> dict:
+        with self._lock:
+            items = sorted(self._series.items())
         return {"type": "gauge", "help": self.help,
                 "series": [{"labels": dict(k), "value": v}
-                           for k, v in sorted(self._series.items())]}
+                           for k, v in items]}
 
     def reset(self) -> None:
         with self._lock:
@@ -87,11 +101,34 @@ class Gauge:
 #: single entries to the 2^30 expansion ceiling in 16 buckets
 _DEFAULT_BOUNDS = tuple(4 ** k for k in range(16))
 
+#: per-series cap of raw samples kept for percentile summaries. Beyond
+#: the cap the buffer becomes a ring over the MOST RECENT observations
+#: (a sliding window — for serving latency the recent window is the
+#: interesting one anyway).
+_RESERVOIR = 2048
+
+_QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def _percentiles(samples: list) -> dict:
+    """Nearest-rank p50/p90/p99 of a raw-sample list (empty -> None)."""
+    if not samples:
+        return {q: None for q, _ in _QUANTILES}
+    srt = sorted(samples)
+    out = {}
+    for name, p in _QUANTILES:
+        i = min(len(srt) - 1, max(0, math.ceil(p * len(srt)) - 1))
+        out[name] = srt[i]
+    return out
+
 
 class Histogram:
     """Cumulative-bucket histogram per label set (Prometheus shape:
     bucket[i] counts observations <= bounds[i]; +Inf is implicit via
-    `count`). Tracks sum/count/min/max too."""
+    `count`). Tracks sum/count/min/max, plus a bounded raw-sample
+    window (`_RESERVOIR` most recent) from which `series()` reports
+    p50/p90/p99 — so latency percentiles are readable straight from a
+    snapshot without bucket interpolation."""
 
     def __init__(self, name: str, help: str = "",
                  bounds: tuple = _DEFAULT_BOUNDS):
@@ -110,31 +147,45 @@ class Histogram:
             if s is None:
                 s = self._series[k] = {
                     "buckets": [0] * len(self.bounds), "sum": 0.0,
-                    "count": 0, "min": value, "max": value}
+                    "count": 0, "min": value, "max": value,
+                    "samples": []}
             i = bisect.bisect_left(self.bounds, value)
             if i < len(self.bounds):
                 s["buckets"][i] += 1
+            samples = s["samples"]
+            if len(samples) < _RESERVOIR:
+                samples.append(value)
+            else:
+                samples[s["count"] % _RESERVOIR] = value
             s["sum"] += value
             s["count"] += 1
             s["min"] = min(s["min"], value)
             s["max"] = max(s["max"], value)
 
     def series(self, **labels) -> dict | None:
-        s = self._series.get(_key(labels))
-        if s is None:
-            return None
+        with self._lock:
+            s = self._series.get(_key(labels))
+            if s is None:
+                return None
+            # copy under the lock; format outside it
+            s = {**s, "buckets": list(s["buckets"]),
+                 "samples": list(s["samples"])}
         # cumulative buckets on read (updates stay O(1) per observe)
         cum, tot = [], 0
         for b in s["buckets"]:
             tot += b
             cum.append(tot)
-        return {**s, "buckets": cum, "bounds": list(self.bounds)}
+        samples = s.pop("samples")
+        return {**s, "buckets": cum, "bounds": list(self.bounds),
+                **_percentiles(samples)}
 
     def snapshot(self) -> dict:
+        with self._lock:
+            keys = sorted(self._series)
         return {"type": "histogram", "help": self.help,
                 "bounds": list(self.bounds),
                 "series": [{"labels": dict(k), **self.series(**dict(k))}
-                           for k in sorted(self._series)]}
+                           for k in keys]}
 
     def reset(self) -> None:
         with self._lock:
@@ -175,8 +226,8 @@ class Registry:
         """{name: snapshot} for every metric that has data."""
         with self._lock:
             items = list(self._metrics.items())
-        return {name: m.snapshot() for name, m in items
-                if m.snapshot()["series"]}
+        snaps = {name: m.snapshot() for name, m in items}
+        return {name: s for name, s in snaps.items() if s["series"]}
 
     def reset(self) -> None:
         """Clear every metric's series (registrations persist)."""

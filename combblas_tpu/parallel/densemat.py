@@ -27,6 +27,7 @@ from jax.sharding import PartitionSpec as P
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops.semiring import Monoid, Semiring
 from combblas_tpu.parallel.distmat import DistSpMat
+from combblas_tpu.parallel.distvec import DistVec
 from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
 
 Array = jax.Array
@@ -171,6 +172,31 @@ def mv_constant(grid: ProcGrid, axis: str, glen: int, width: int, value,
     data = jnp.full((nb, block, width), value, dtype)
     data = jax.device_put(data, grid.sharding(axis, None, None))
     return DistMultiVec(data, grid, axis, glen)
+
+
+def mv_stack(vecs: list) -> DistMultiVec:
+    """Stack identically aligned DistVecs as the columns of one
+    DistMultiVec (the serve batcher's coalescing step: k concurrent
+    SpMV operands become one width-k SpMM operand)."""
+    if not vecs:
+        raise ValueError("nothing to stack")
+    v0 = vecs[0]
+    for v in vecs[1:]:
+        if (v.axis, v.glen, v.data.shape) != (v0.axis, v0.glen,
+                                              v0.data.shape):
+            raise ValueError("mv_stack needs identically aligned vectors")
+    data = jnp.stack([v.data for v in vecs], axis=-1)
+    data = lax.with_sharding_constraint(
+        data, v0.grid.sharding(v0.axis, None, None))
+    return DistMultiVec(data, v0.grid, v0.axis, v0.glen)
+
+
+def mv_column(mv: DistMultiVec, w: int) -> DistVec:
+    """Column ``w`` of a multi-vector as a DistVec (the un-batching
+    step after a stacked dispatch)."""
+    data = lax.with_sharding_constraint(
+        mv.data[:, :, w], mv.grid.sharding(mv.axis, None))
+    return DistVec(data, mv.grid, mv.axis, mv.glen)
 
 
 def mv_realign(v: DistMultiVec, axis: str, block: Optional[int] = None,

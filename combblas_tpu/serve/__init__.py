@@ -1,0 +1,44 @@
+"""Graph-query serving layer: request queue, dynamic batcher, plan
+cache, deadlines.
+
+The ROADMAP north star is serving heavy query traffic, but every
+driver in `models/` is one-shot: each BFS/CC/SpMV pays its own
+dispatch + readback round trip — the overhead class the round-5
+verdict measured at ~63% of expansion wall time. This package is the
+request-level layer that amortizes it, the same shape as an inference
+serving stack:
+
+* `serve.queue`   — thread-safe FIFO with admission control (bounded
+  depth -> `QueueFullError` backpressure) and per-request deadlines;
+* `serve.batcher` — coalesces concurrent same-kind queries into one
+  device dispatch: BFS roots become the columns of a batched
+  `bfs_batch` traversal, SpMV/SpMSpV operands stack into a
+  `DistMultiVec` SpMM, CC label lookups share one gather. Batch
+  widths are bucketed so every dispatch hits the jit cache;
+* `serve.plans`   — the executable cache keyed (kind, semiring,
+  bucket, mesh) with warm-up prefill;
+* `serve.engine`  — `GraphService`: the worker loop wiring queue ->
+  batcher -> dispatch -> readback, deadline degradation (partial BFS
+  levels, queue shed), and full `combblas_tpu.obs` instrumentation.
+
+Quick start::
+
+    from combblas_tpu import serve
+    svc = serve.GraphService(a)          # a: DistSpMat (symmetric)
+    h1 = svc.submit_bfs(root=7)
+    h2 = svc.submit_cc(vertex=42)
+    parents = h1.result().parents        # blocks; np.ndarray (n,)
+    label = h2.result()
+    svc.stop()
+
+Not imported from the package root (it pulls `models.bfs`): use
+``from combblas_tpu import serve`` explicitly.
+"""
+
+from combblas_tpu.serve.queue import (
+    DeadlineExceededError, QueueFullError, Request, RequestQueue,
+    ResultHandle, ServeError, ServiceStoppedError,
+)
+from combblas_tpu.serve.batcher import Batch, DynamicBatcher, bucket_for
+from combblas_tpu.serve.plans import PlanCache, PlanKey
+from combblas_tpu.serve.engine import BfsResult, GraphService

@@ -1,0 +1,105 @@
+"""Dynamic batcher: coalesce concurrent same-kind queries into one
+device dispatch.
+
+Policy: the head-of-queue request fixes the batch kind; the batcher
+pulls every queued request of that kind (up to the largest bucket)
+and lingers up to ``batch_wait_s`` for stragglers — latency is traded
+for occupancy only while the batch is not yet full. Expired requests
+are shed at formation time (their handles get `DeadlineExceededError`;
+the shed counter records why) so a dead request never occupies a
+device slot.
+
+Batch widths are BUCKETED (`bucket_for`): the executors pad every
+batch up to the smallest configured bucket that fits, so a service
+with buckets (1, 2, 4, 8, 16, 32) compiles at most 6 executables per
+query kind and every dispatch is a jit-cache hit — the same
+shape-bucketing discipline as `distmat._qbucket` for nnz capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from combblas_tpu.serve.queue import (
+    DeadlineExceededError, Request, RequestQueue,
+)
+
+
+def bucket_for(n: int, buckets: tuple) -> int:
+    """Smallest configured bucket >= n (callers split batches larger
+    than the top bucket, so n <= max(buckets) always holds there)."""
+    if n < 1:
+        raise ValueError("empty batch has no bucket")
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} exceeds largest bucket "
+                     f"{max(buckets)}")
+
+
+@dataclasses.dataclass
+class Batch:
+    """Formed batch: same-kind requests plus the padded width the
+    executor will dispatch at."""
+
+    kind: str
+    requests: list
+    bucket: int
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.requests) / self.bucket
+
+
+class DynamicBatcher:
+    """Pulls batches off a `RequestQueue`. ``on_shed(request,
+    reason)`` is called for every request dropped at formation time
+    (after its handle got the typed error) so the engine can count
+    sheds without the batcher knowing about metrics."""
+
+    def __init__(self, queue: RequestQueue, buckets: tuple,
+                 batch_wait_s: float = 0.0, on_shed=None):
+        self.queue = queue
+        self.buckets = tuple(sorted(buckets))
+        self.batch_wait_s = batch_wait_s
+        self.on_shed = on_shed
+
+    def _shed_expired(self, reqs: list) -> list:
+        now = time.monotonic()
+        live = []
+        for r in reqs:
+            if r.expired(now):
+                r.handle.set_exception(DeadlineExceededError(
+                    f"{r.kind} deadline expired after "
+                    f"{now - r.enqueued_at:.4f}s in queue"))
+                if self.on_shed is not None:
+                    self.on_shed(r, "deadline")
+            else:
+                live.append(r)
+        return live
+
+    def form(self) -> Optional[Batch]:
+        """Form the next batch, or None when the queue is empty (or
+        everything pulled had expired). Non-blocking apart from the
+        linger window."""
+        kind = self.queue.head_kind()
+        if kind is None:
+            return None
+        cap = self.buckets[-1]
+        reqs = self.queue.take(kind, cap)
+        if self.batch_wait_s > 0 and len(reqs) < cap:
+            t_end = time.monotonic() + self.batch_wait_s
+            while len(reqs) < cap:
+                more = self.queue.take(kind, cap - len(reqs))
+                reqs.extend(more)
+                rem = t_end - time.monotonic()
+                if rem <= 0:
+                    break
+                if not more:
+                    time.sleep(min(rem, 5e-4))
+        reqs = self._shed_expired(reqs)
+        if not reqs:
+            return None
+        return Batch(kind, reqs, bucket_for(len(reqs), self.buckets))

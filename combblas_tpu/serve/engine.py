@@ -1,0 +1,424 @@
+"""`GraphService`: the worker loop wiring queue -> batcher ->
+dispatch -> readback.
+
+One daemon worker thread owns the device: it pulls a same-kind batch
+off the queue (batcher.py), pads it to the jit bucket, runs ONE
+compiled executable for the whole batch (plans.py), and fans results
+back out to the per-request handles. Query kinds:
+
+* **bfs** — roots ride the columns of `models.bfs.bfs_batch` (one
+  while_loop traversal for the whole batch, bit-exact vs per-root
+  `bfs`). Deadlines degrade gracefully: the level budget is
+  min-remaining-time / EWMA-per-level-estimate, and roots whose
+  traversal was truncated return `BfsResult(complete=False)` with the
+  partial parents rather than an error.
+* **cc** — component labels are computed ONCE (lazy `cc.fastsv`, a
+  single amortized dispatch); each batch of lookups is one device
+  gather.
+* **spmv:<semiring>** — operand vectors stack into the columns of one
+  `densemat.spmm`. SpMSpV queries densify (mask -> add-identity,
+  which annihilates every shipped semiring's multiply) and coalesce
+  into the SAME batches.
+
+Instrumented through `combblas_tpu.obs` (queue-depth gauge,
+batch-occupancy + latency histograms with p50/p90/p99, shed/dispatch
+counters) AND a plain `stats` dict that counts regardless of whether
+obs is enabled — tests and callers read `stats`, dashboards read obs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from combblas_tpu import obs
+from combblas_tpu.models import bfs as _bfs
+from combblas_tpu.models import cc as _cc
+from combblas_tpu.ops.semiring import PLUS_TIMES_F32, Semiring
+from combblas_tpu.parallel import densemat as dmm
+from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
+from combblas_tpu.serve.batcher import Batch, DynamicBatcher
+from combblas_tpu.serve.plans import PlanCache, PlanKey
+from combblas_tpu.serve.queue import (
+    Request, RequestQueue, ResultHandle, ServiceStoppedError,
+)
+from combblas_tpu.utils.config import ServeConfig
+
+_queue_depth = obs.gauge("serve.queue_depth", "requests waiting")
+_occupancy = obs.histogram(
+    "serve.batch_occupancy", "filled fraction of the dispatched bucket",
+    bounds=tuple((k + 1) / 8 for k in range(8)))
+_latency = obs.histogram(
+    "serve.latency_s", "submit->result wall seconds per request",
+    bounds=tuple(1e-4 * 2 ** k for k in range(22)))
+_dispatches = obs.counter("serve.dispatches",
+                          "device dispatches by query kind")
+_shed = obs.counter("serve.shed", "requests shed, by reason")
+
+
+@dataclasses.dataclass
+class BfsResult:
+    """One root's traversal result. ``complete`` is False when the
+    deadline's level budget truncated the traversal — ``parents`` then
+    holds every vertex reached within ``levels`` levels (a valid BFS
+    prefix), not the full tree."""
+
+    parents: np.ndarray     # (n,) int32, NO_PARENT where unreached
+    levels: int             # levels the batch ran
+    complete: bool
+    root: int
+
+
+class GraphService:
+    """Batching query service over one distributed matrix.
+
+    ``a`` must satisfy the same contract as `models.bfs.bfs` /
+    `models.cc.fastsv`: incoming-edge orientation, symmetric for BFS
+    parity with the reference. Construct, submit, read handles::
+
+        svc = GraphService(a)
+        handles = [svc.submit_bfs(r) for r in roots]
+        results = [h.result() for h in handles]
+        svc.stop()
+
+    ``autostart=False`` leaves the worker stopped so tests can queue a
+    known set of requests and `start()` deterministic batches.
+    """
+
+    def __init__(self, a, config: Optional[ServeConfig] = None, *,
+                 autostart: bool = True):
+        self.a = a
+        self.cfg = config or ServeConfig()
+        self.queue = RequestQueue(self.cfg.max_queue_depth)
+        self.plans = PlanCache()
+        self.batcher = DynamicBatcher(
+            self.queue, self.cfg.buckets, self.cfg.batch_wait_s,
+            on_shed=self._note_shed)
+        # plain-python mirror of the obs counters: obs only records
+        # when tracing is enabled; `stats` always counts
+        self.stats = {"queries": 0, "results": 0, "batches": 0,
+                      "dispatches": 0, "warmup_dispatches": 0,
+                      "shed": 0, "partials": 0}
+        self._stats_lock = threading.Lock()
+        self._mesh = (a.grid.pr, a.grid.pc)
+        self._bfs_level_est = self.cfg.bfs_level_est_s
+        self._cc_labels = None          # lazy device label vector
+        self._cc_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._worker, name="graphservice-worker", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop the worker. ``drain=True`` serves everything already
+        queued first; ``drain=False`` fails pending requests with
+        `ServiceStoppedError`."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        if not drain:
+            self._fail_pending()
+        self._thread.join()
+        self._thread = None
+        self._fail_pending()    # anything that raced the final check
+
+    def _fail_pending(self) -> None:
+        for r in self.queue.drain():
+            r.handle.set_exception(
+                ServiceStoppedError("service stopped"))
+            self._note_shed(r, "stopped")
+
+    def __enter__(self) -> "GraphService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=exc[0] is None)
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+
+    def _submit(self, kind: str, payload,
+                deadline_s: Optional[float]) -> ResultHandle:
+        # pre-start submission is allowed (autostart=False queues a
+        # known set, then start() forms deterministic batches); only a
+        # stopping/stopped service refuses
+        if self._stop.is_set():
+            raise ServiceStoppedError("service is stopped")
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        now = time.monotonic()
+        deadline = None if deadline_s is None else now + deadline_s
+        h = ResultHandle()
+        self.queue.put(Request(kind, payload, h, deadline, now))
+        with self._stats_lock:
+            self.stats["queries"] += 1
+        _queue_depth.set(len(self.queue))
+        return h
+
+    def submit_bfs(self, root: int,
+                   deadline_s: Optional[float] = None) -> ResultHandle:
+        """BFS from ``root``; handle resolves to a `BfsResult`."""
+        return self._submit("bfs", int(root), deadline_s)
+
+    def submit_cc(self, vertex: int,
+                  deadline_s: Optional[float] = None) -> ResultHandle:
+        """Connected-component label of ``vertex`` (int; two vertices
+        are connected iff their labels match)."""
+        return self._submit("cc", int(vertex), deadline_s)
+
+    def submit_spmv(self, x, sr: Semiring = PLUS_TIMES_F32,
+                    deadline_s: Optional[float] = None) -> ResultHandle:
+        """y = A (x) x for a dense host vector ``x`` (len ncols);
+        handle resolves to the (nrows,) result array. Same-semiring
+        queries coalesce into one SpMM."""
+        x = np.asarray(x)
+        if x.shape != (self.a.ncols,):
+            raise ValueError(f"x must be ({self.a.ncols},)")
+        if jnp.dtype(sr.dtype) != self.a.vals.dtype:
+            raise ValueError(
+                f"semiring dtype {jnp.dtype(sr.dtype)} does not match "
+                f"matrix values {self.a.vals.dtype} (rebuild the "
+                "matrix or pick a matching semiring)")
+        return self._submit(f"spmv:{sr.name}", (x, sr), deadline_s)
+
+    def submit_spmsv(self, indices, values,
+                     sr: Semiring = PLUS_TIMES_F32,
+                     deadline_s: Optional[float] = None) -> ResultHandle:
+        """Sparse operand as (indices, values); densified with the
+        add-identity (which annihilates multiply for every shipped
+        semiring) so it batches with `submit_spmv` of the same
+        semiring."""
+        ident = sr.add.identity_scalar(sr.dtype)
+        x = np.full((self.a.ncols,), ident,
+                    dtype=np.dtype(jnp.dtype(sr.dtype).name))
+        x[np.asarray(indices, np.int64)] = np.asarray(values)
+        return self._submit(f"spmv:{sr.name}", (x, sr), deadline_s)
+
+    # blocking conveniences
+    def bfs(self, root: int, deadline_s: Optional[float] = None):
+        return self.submit_bfs(root, deadline_s).result()
+
+    def cc(self, vertex: int, deadline_s: Optional[float] = None):
+        return self.submit_cc(vertex, deadline_s).result()
+
+    def spmv(self, x, sr: Semiring = PLUS_TIMES_F32,
+             deadline_s: Optional[float] = None):
+        return self.submit_spmv(x, sr, deadline_s).result()
+
+    # ------------------------------------------------------------------
+    # worker loop
+    # ------------------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            if self._stop.is_set() and len(self.queue) == 0:
+                return
+            if not self.queue.wait_nonempty(self.cfg.drain_poll_s):
+                continue
+            batch = self.batcher.form()
+            _queue_depth.set(len(self.queue))
+            if batch is None:
+                continue
+            try:
+                self._execute(batch)
+            except BaseException as e:   # noqa: BLE001 — fan out, keep serving
+                for r in batch.requests:
+                    if not r.handle.done():
+                        r.handle.set_exception(e)
+
+    def _execute(self, batch: Batch) -> None:
+        with obs.span("serve.batch", kind=batch.kind,
+                      width=len(batch.requests), bucket=batch.bucket):
+            if batch.kind == "bfs":
+                self._run_bfs(batch)
+            elif batch.kind == "cc":
+                self._run_cc(batch)
+            elif batch.kind.startswith("spmv:"):
+                self._run_spmv(batch)
+            else:
+                raise ValueError(f"unknown query kind {batch.kind!r}")
+        with self._stats_lock:
+            self.stats["batches"] += 1
+        _occupancy.observe(batch.occupancy, kind=batch.kind)
+
+    def _finish(self, req: Request, value) -> None:
+        req.handle.set_result(value)
+        _latency.observe(time.monotonic() - req.enqueued_at,
+                         kind=req.kind)
+        with self._stats_lock:
+            self.stats["results"] += 1
+
+    def _note_shed(self, req: Request, reason: str) -> None:
+        with self._stats_lock:
+            self.stats["shed"] += 1
+        _shed.inc(kind=req.kind, reason=reason)
+
+    def _count_dispatch(self, kind: str, warmup: bool = False) -> None:
+        with self._stats_lock:
+            self.stats["warmup_dispatches" if warmup
+                       else "dispatches"] += 1
+        _dispatches.inc(kind=kind, warmup=int(warmup))
+
+    # ------------------------------------------------------------------
+    # executors (one device dispatch per batch)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _pad(arr: np.ndarray, bucket: int) -> np.ndarray:
+        """Pad a batch axis up to the bucket by repeating entry 0 (a
+        real query, so padding never introduces new compile shapes or
+        out-of-range indices)."""
+        pad = bucket - arr.shape[0]
+        if pad == 0:
+            return arr
+        return np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)])
+
+    def _bfs_plan(self, bucket: int):
+        key = PlanKey("bfs", "select2nd_max_i32", bucket, self._mesh)
+        return self.plans.get_or_build(
+            key, lambda: lambda roots, ml: _bfs.bfs_batch(
+                self.a, roots, ml))
+
+    def _run_bfs(self, batch: Batch) -> None:
+        reqs = batch.requests
+        roots = np.array([r.payload for r in reqs], np.int32)
+        roots_p = self._pad(roots, batch.bucket)
+        # deadline -> level budget: enough levels to fit the tightest
+        # remaining deadline at the current EWMA per-level estimate
+        # (floor 1: always make progress). 0 = unbounded.
+        ml = self.cfg.bfs_max_levels
+        rem = [r.remaining() for r in reqs if r.deadline is not None]
+        if rem:
+            budget = max(1, int(min(rem) /
+                                max(self._bfs_level_est, 1e-9)))
+            ml = budget if ml <= 0 else min(ml, budget)
+        fn = self._bfs_plan(batch.bucket)
+        t0 = time.monotonic()
+        mv, lvl, done = fn(jnp.asarray(roots_p), jnp.int32(ml))
+        parents = mv.to_global()              # blocks on readback
+        wall = time.monotonic() - t0
+        self._count_dispatch("bfs")
+        levels = int(lvl)
+        done = np.asarray(done)
+        if levels > 0:
+            self._bfs_level_est = (0.7 * self._bfs_level_est
+                                   + 0.3 * wall / levels)
+        for k, r in enumerate(reqs):
+            complete = bool(done[k])
+            if not complete:
+                with self._stats_lock:
+                    self.stats["partials"] += 1
+            self._finish(r, BfsResult(parents[:, k], levels, complete,
+                                      int(roots[k])))
+
+    def _labels_device(self):
+        """Component labels, computed once for the service lifetime
+        (the single amortized dispatch every CC lookup shares)."""
+        with self._cc_lock:
+            if self._cc_labels is None:
+                labels = _cc.fastsv(self.a)
+                self._cc_labels = jnp.asarray(labels.to_global())
+                self._count_dispatch("cc_labels")
+            return self._cc_labels
+
+    def _run_cc(self, batch: Batch) -> None:
+        reqs = batch.requests
+        labels = self._labels_device()
+        verts = np.array([r.payload for r in reqs], np.int32)
+        verts_p = self._pad(verts, batch.bucket)
+        key = PlanKey("cc", "-", batch.bucket, self._mesh)
+        fn = self.plans.get_or_build(
+            key, lambda: jax.jit(lambda lab, ix: lab[ix]))
+        out = np.asarray(fn(labels, jnp.asarray(verts_p)))
+        self._count_dispatch("cc")
+        for k, r in enumerate(reqs):
+            self._finish(r, int(out[k]))
+
+    def _spmv_plan(self, sr: Semiring, bucket: int):
+        key = PlanKey("spmv", sr.name, bucket, self._mesh)
+
+        def build():
+            grid, tn, glen = self.a.grid, self.a.tile_n, self.a.ncols
+            nrows = self.a.nrows
+
+            @partial(jax.jit)
+            def run(a, arr):                  # arr: (glen, W)
+                data = jnp.pad(
+                    arr, ((0, grid.pc * tn - glen), (0, 0)))
+                x = dmm.DistMultiVec(
+                    data.reshape(grid.pc, tn, arr.shape[1]), grid,
+                    COL_AXIS, glen)
+                return dmm.spmm(sr, a, x).data
+
+            def call(arr):
+                y = np.asarray(run(self.a, jnp.asarray(arr, sr.dtype)))
+                return y.reshape(-1, arr.shape[1])[:nrows]
+            return call
+        return self.plans.get_or_build(key, build)
+
+    def _run_spmv(self, batch: Batch) -> None:
+        reqs = batch.requests
+        sr = reqs[0].payload[1]
+        xs = np.stack([r.payload[0] for r in reqs])    # (w, glen)
+        xs = self._pad(xs, batch.bucket).T             # (glen, bucket)
+        fn = self._spmv_plan(sr, batch.bucket)
+        y = fn(xs)                                     # (nrows, bucket)
+        self._count_dispatch(f"spmv:{sr.name}")
+        for k, r in enumerate(reqs):
+            self._finish(r, y[:, k])
+
+    # ------------------------------------------------------------------
+    # warm-up prefill
+    # ------------------------------------------------------------------
+
+    def warmup(self, kinds=("bfs", "cc"), buckets=None) -> int:
+        """Compile every (kind x bucket) executable with a dummy batch
+        so steady-state traffic never pays a first-touch compile.
+        ``kinds`` entries are "bfs", "cc", or a `Semiring` (= spmv of
+        that semiring). Returns the number of warm-up dispatches
+        (counted in stats["warmup_dispatches"], not "dispatches")."""
+        buckets = tuple(buckets or self.cfg.buckets)
+        n = 0
+        for kind in kinds:
+            for b in buckets:
+                if kind == "bfs":
+                    mv, lvl, done = self._bfs_plan(b)(
+                        jnp.zeros((b,), jnp.int32), jnp.int32(1))
+                    jax.block_until_ready(mv.data)
+                    self._count_dispatch("bfs", warmup=True)
+                elif kind == "cc":
+                    labels = self._labels_device()
+                    key = PlanKey("cc", "-", b, self._mesh)
+                    fn = self.plans.get_or_build(
+                        key, lambda: jax.jit(lambda lab, ix: lab[ix]))
+                    np.asarray(fn(labels, jnp.zeros((b,), jnp.int32)))
+                    self._count_dispatch("cc", warmup=True)
+                elif isinstance(kind, Semiring):
+                    self._spmv_plan(kind, b)(
+                        np.zeros((self.a.ncols, b)))
+                    self._count_dispatch(f"spmv:{kind.name}",
+                                         warmup=True)
+                else:
+                    raise ValueError(f"unknown warmup kind {kind!r}")
+                n += 1
+        return n

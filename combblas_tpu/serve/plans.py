@@ -1,0 +1,85 @@
+"""Plan cache: jitted executables keyed on (kind, semiring, bucket,
+mesh), with warm-up prefill.
+
+jax's jit cache already keys compiled executables on abstract shapes;
+what it cannot answer is "will THIS dispatch compile or run?" — on
+the emulated CPU mesh (and cold TPU pods) a first-touch compile is
+seconds to minutes, which inside a serving loop is a deadline
+massacre. The plan cache makes the executable set explicit: one entry
+per (kind, semiring, bucket, mesh-shape), a `build` miss is the ONLY
+place a compile can happen, and `GraphService.warmup()` walks every
+(kind x bucket) with dummy batches so steady-state traffic never eats
+a compile. Hit/miss counters flow to `obs` and the engine's stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, NamedTuple
+
+from combblas_tpu import obs
+
+_plan_hits = obs.counter("serve.plan_hits",
+                         "plan-cache hits by kind/bucket")
+_plan_misses = obs.counter("serve.plan_misses",
+                           "plan-cache misses (compiles) by kind/bucket")
+
+
+class PlanKey(NamedTuple):
+    """Identity of one compiled executable."""
+
+    kind: str          # "bfs" | "cc" | "spmv:<semiring>" | ...
+    semiring: str      # semiring name, or "-" when kind implies it
+    bucket: int        # padded batch width
+    mesh: tuple        # (pr, pc) grid shape
+
+
+@dataclasses.dataclass
+class PlanEntry:
+    fn: Callable
+    hits: int = 0
+
+
+class PlanCache:
+    """key -> executor map. `get_or_build` is the single choke point:
+    the builder runs at most once per key (double-checked under the
+    lock), every later lookup is a hit."""
+
+    def __init__(self):
+        self._plans: dict[PlanKey, PlanEntry] = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._plans)
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._plans)
+
+    def get_or_build(self, key: PlanKey,
+                     builder: Callable[[], Callable]) -> Callable:
+        with self._lock:
+            e = self._plans.get(key)
+            if e is not None:
+                e.hits += 1
+                _plan_hits.inc(kind=key.kind, bucket=key.bucket)
+                return e.fn
+        # build OUTSIDE the lock (compiles are long; lookups of other
+        # keys must not stall behind them), then settle races under it
+        fn = builder()
+        with self._lock:
+            e = self._plans.get(key)
+            if e is None:
+                e = self._plans[key] = PlanEntry(fn)
+                _plan_misses.inc(kind=key.kind, bucket=key.bucket)
+            else:
+                e.hits += 1
+                _plan_hits.inc(kind=key.kind, bucket=key.bucket)
+            return e.fn
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {f"{k.kind}/w{k.bucket}": e.hits
+                    for k, e in sorted(self._plans.items())}

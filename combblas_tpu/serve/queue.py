@@ -1,0 +1,157 @@
+"""Thread-safe request queue with admission control, backpressure,
+and per-request deadlines.
+
+The queue is the service's only buffer: a bounded FIFO whose bound IS
+the backpressure mechanism — `put` on a full queue raises
+`QueueFullError` immediately (clients retry or shed load) instead of
+queueing unboundedly and letting every deadline expire at once.
+Deadlines are absolute `time.monotonic()` instants checked at three
+points: admission (dead-on-arrival -> raise), batch formation
+(expired in queue -> shed with `DeadlineExceededError` on the
+handle), and in-flight (the engine degrades to a level-budgeted
+partial BFS rather than erroring — see engine.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Optional
+
+
+class ServeError(Exception):
+    """Base of the serving layer's typed errors."""
+
+
+class QueueFullError(ServeError):
+    """Admission control: queue at max depth — retry later (the
+    backpressure signal)."""
+
+
+class DeadlineExceededError(ServeError):
+    """The request's deadline passed before it could be (fully)
+    served."""
+
+
+class ServiceStoppedError(ServeError):
+    """Submitted to, or left pending in, a stopped service."""
+
+
+class ResultHandle:
+    """Future for one request: the worker thread fulfills it, the
+    client blocks on `result()`."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, value) -> None:
+        self._result = value
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until fulfilled; returns the value or raises the
+        request's error (TimeoutError if ``timeout`` elapses first)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("result not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued query. ``kind`` is the batching key — only same-kind
+    requests coalesce (e.g. "bfs", "cc", "spmv:plus_times_f32")."""
+
+    kind: str
+    payload: Any
+    handle: ResultHandle
+    deadline: Optional[float]       # absolute time.monotonic(), or None
+    enqueued_at: float
+
+    def remaining(self, now: Optional[float] = None) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.deadline - (time.monotonic() if now is None else now)
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        r = self.remaining(now)
+        return r is not None and r <= 0
+
+
+class RequestQueue:
+    """Bounded FIFO with kind-selective removal (the batcher pulls
+    runs of same-kind requests without disturbing the order of the
+    rest). All operations lock; `wait_nonempty` parks on a condition
+    so the worker never spins on an empty queue."""
+
+    def __init__(self, max_depth: int):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1")
+        self.max_depth = max_depth
+        self._q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def put(self, req: Request) -> None:
+        """Admit a request, or raise: `QueueFullError` at max depth,
+        `DeadlineExceededError` when dead on arrival."""
+        if req.expired():
+            raise DeadlineExceededError(
+                f"{req.kind} request dead on arrival")
+        with self._lock:
+            if len(self._q) >= self.max_depth:
+                raise QueueFullError(
+                    f"queue at max depth {self.max_depth}")
+            self._q.append(req)
+            self._nonempty.notify()
+
+    def wait_nonempty(self, timeout: Optional[float] = None) -> bool:
+        """Block until the queue has work (or ``timeout``); True iff
+        non-empty on return."""
+        with self._lock:
+            if not self._q:
+                self._nonempty.wait(timeout)
+            return bool(self._q)
+
+    def head_kind(self) -> Optional[str]:
+        with self._lock:
+            return self._q[0].kind if self._q else None
+
+    def take(self, kind: str, limit: int) -> list:
+        """Remove and return up to ``limit`` requests of ``kind``,
+        scanning from the front (FIFO among that kind; other kinds
+        keep their relative order)."""
+        out = []
+        with self._lock:
+            if not self._q or limit <= 0:
+                return out
+            keep = collections.deque()
+            while self._q and len(out) < limit:
+                r = self._q.popleft()
+                (out if r.kind == kind else keep).append(r)
+            keep.extend(self._q)
+            self._q = keep
+        return out
+
+    def drain(self) -> list:
+        """Remove and return everything (shutdown path)."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
+        return out

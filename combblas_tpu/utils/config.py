@@ -64,6 +64,18 @@ class SpGemmBenchConfig:
     seed: int = 1
 
 
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """`serve.GraphService` knobs (queue, batcher, deadlines)."""
+    max_queue_depth: int = 512      # admission control: above -> shed
+    buckets: tuple = (1, 2, 4, 8, 16, 32)   # batch-width jit buckets
+    batch_wait_s: float = 0.002     # max linger waiting to fill a batch
+    default_deadline_s: Optional[float] = None  # per-request override wins
+    bfs_level_est_s: float = 2e-3   # EWMA seed for per-level wall time
+    bfs_max_levels: int = 0         # 0 = unbounded (deadline may cap)
+    drain_poll_s: float = 0.05      # shutdown drain poll interval
+
+
 def parse_cli(cls: Type[T], argv: Optional[list] = None,
               prog: Optional[str] = None) -> T:
     """Build an argparse CLI from a config dataclass: every field
@@ -87,5 +99,5 @@ def _resolve(t):
     return {"int": int, "float": float, "str": str}.get(t, str)
 
 
-__all__ = ["BfsConfig", "SpGemmBenchConfig", "MclParams", "parse_cli",
-           "setup_compilation_cache"]
+__all__ = ["BfsConfig", "SpGemmBenchConfig", "ServeConfig", "MclParams",
+           "parse_cli", "setup_compilation_cache"]

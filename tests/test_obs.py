@@ -299,6 +299,133 @@ def test_tracer_bounded_and_reset(obs_on):
 
 
 # ---------------------------------------------------------------------------
+# concurrency: metrics are safe for multi-threaded emitters
+# ---------------------------------------------------------------------------
+
+def test_metrics_concurrent_emitters(obs_on):
+    """N threads hammer one counter/gauge/histogram while a reader
+    snapshots: totals must be exact (no lost updates) and snapshots
+    must never tear (serve workers emit from multiple threads)."""
+    import threading
+
+    c = metrics.Counter("t.conc.c")
+    g = metrics.Gauge("t.conc.g")
+    h = metrics.Histogram("t.conc.h", bounds=(10, 100, 1000))
+    nthreads, per = 8, 500
+    stop = threading.Event()
+    snaps = []
+
+    def emit(tid):
+        for i in range(per):
+            c.inc(kind="w")
+            g.set(i, tid=tid)
+            h.observe(i % 700, tid=tid)
+
+    def read():
+        while not stop.is_set():
+            snaps.append((c.snapshot(), h.snapshot()))
+
+    threads = [threading.Thread(target=emit, args=(t,))
+               for t in range(nthreads)]
+    reader = threading.Thread(target=read)
+    reader.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    reader.join()
+    assert c.value(kind="w") == nthreads * per
+    for t in range(nthreads):
+        assert g.value(tid=t) == per - 1
+        assert h.series(tid=t)["count"] == per
+    assert snaps  # the reader really raced the writers
+
+
+def test_spans_concurrent_threads(obs_on):
+    """Span stacks are per-thread (threading.local): spans opened on
+    different threads never nest into each other."""
+    import threading
+
+    tr = trace.Tracer()
+
+    def worker(name):
+        with trace.span(name, tracer=tr):
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=worker, args=(f"t{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tr.records) == 4
+    for r in tr.records:
+        assert r.depth == 0 and len(r.path) == 1   # no cross-thread nesting
+
+
+# ---------------------------------------------------------------------------
+# percentile summaries (p50/p90/p99)
+# ---------------------------------------------------------------------------
+
+def test_histogram_percentiles_exact(obs_on):
+    h = metrics.Histogram("t.pct", bounds=(1000,))
+    for v in range(1, 101):                 # 1..100
+        h.observe(float(v))
+    s = h.series()
+    assert s["p50"] == 50.0                 # nearest-rank
+    assert s["p90"] == 90.0
+    assert s["p99"] == 99.0
+    # a heavy tail moves p99 but not p50 (nearest-rank: index
+    # ceil(0.99*100)-1 = 98 of the sorted samples)
+    h2 = metrics.Histogram("t.pct2", bounds=(1000,))
+    for v in [1] * 98 + [500, 500]:
+        h2.observe(v)
+    s2 = h2.series()
+    assert s2["p50"] == 1 and s2["p99"] == 500
+
+
+def test_histogram_reservoir_slides(obs_on):
+    """Beyond the reservoir cap the sample window covers the most
+    recent observations, so percentiles track the current regime."""
+    h = metrics.Histogram("t.slide", bounds=(10**9,))
+    for _ in range(metrics._RESERVOIR):
+        h.observe(1.0)
+    for _ in range(metrics._RESERVOIR):     # new regime overwrites all
+        h.observe(100.0)
+    s = h.series()
+    assert s["count"] == 2 * metrics._RESERVOIR
+    assert s["p50"] == 100.0 and s["p99"] == 100.0
+
+
+def test_report_includes_histogram_percentiles(obs_on):
+    metrics.histogram("t.rep.lat").observe(3.0, kind="bfs")
+    txt = export.format_report()
+    assert "-- histograms --" in txt
+    assert "t.rep.lat{kind=bfs}" in txt
+    assert "p99" in txt
+
+
+def test_jsonl_metrics_line(obs_on, tmp_path):
+    tr = trace.Tracer()
+    _trace_a_region(tr)
+    metrics.counter("t.jl.c").inc(4, kind="x")
+    metrics.histogram("t.jl.h").observe(2.5)
+    p = tmp_path / "spans.jsonl"
+    n = export.to_jsonl(p, tr)
+    assert n == 6                            # return value: span count
+    # spans round-trip unchanged (the metrics line is skipped)
+    assert len(export.read_jsonl(p)) == 6
+    snap = export.read_jsonl_metrics(p)
+    assert snap["t.jl.c"]["series"][0]["value"] == 4
+    hs = snap["t.jl.h"]["series"][0]
+    assert hs["count"] == 1 and hs["p50"] == 2.5 and hs["p99"] == 2.5
+    # opt-out leaves a pure span log
+    export.to_jsonl(p, tr, include_metrics=False)
+    assert export.read_jsonl_metrics(p) is None
+
+
+# ---------------------------------------------------------------------------
 # the utils.timing compat shim
 # ---------------------------------------------------------------------------
 

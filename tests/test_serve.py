@@ -1,0 +1,435 @@
+"""Serving layer: batched multi-source BFS parity, queue/batcher
+semantics, deadlines, plan cache, and the dispatch-amortization
+acceptance bound — all on the emulated 8-device mesh.
+
+Compile discipline: everything shares one module-scoped matrix and
+SHORT bucket lists — every (kind, bucket) pair compiles its own
+executable on the slow CPU backend, so tests reuse the same widths.
+The 512-query soak (the ISSUE acceptance workload) is `slow`; tier-1
+proves the same >=8x bound on a 96-query workload.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import serve
+from combblas_tpu.models import bfs as B
+from combblas_tpu.models import cc as C
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import distvec as dvv
+from combblas_tpu.parallel import spmv as sp
+from combblas_tpu.parallel.densemat import mv_column, mv_stack
+from combblas_tpu.parallel.grid import COL_AXIS, ProcGrid
+from combblas_tpu.utils.config import ServeConfig
+
+
+@pytest.fixture(scope="module")
+def grid24(devices):
+    return ProcGrid.make(2, 4, devices)
+
+
+@pytest.fixture(scope="module")
+def graph(grid24):
+    """Symmetric random graph, n=192, with isolated vertices (so CC
+    has several components and BFS trees do not span everything)."""
+    rng = np.random.default_rng(7)
+    n, m = 192, 420
+    r = rng.integers(0, n - 8, m)          # leave the top 8 isolated
+    c = rng.integers(0, n - 8, m)
+    rows = np.concatenate([r, c]).astype(np.int32)
+    cols = np.concatenate([c, r]).astype(np.int32)
+    vals = rng.integers(1, 4, len(rows)).astype(np.float32)
+    a = DM.from_global_coo(S.PLUS, grid24, rows, cols, vals, n, n)
+    return a, n
+
+
+@pytest.fixture(scope="module")
+def bfs_plan(graph):
+    a, _ = graph
+    return B.plan_bfs(a)
+
+
+def seq_bfs(a, plan, roots):
+    return {int(r): B.bfs(a, int(r), plan).to_global() for r in set(roots)}
+
+
+# ---------------------------------------------------------------------------
+# bfs_batch: bit-exact parity with per-root bfs
+# ---------------------------------------------------------------------------
+
+class TestBfsBatch:
+    def test_parity_with_duplicate_roots(self, graph, bfs_plan):
+        a, n = graph
+        roots = [0, 5, 5, 17, 99, 0, 150, 42]     # duplicates included
+        mv, lvl, done = B.bfs_batch(a, np.array(roots, np.int32))
+        pg = mv.to_global()
+        ref = seq_bfs(a, bfs_plan, roots)
+        for k, root in enumerate(roots):
+            np.testing.assert_array_equal(pg[:, k], ref[root])
+        assert bool(np.all(np.asarray(done)))
+        assert int(lvl) > 0
+
+    def test_isolated_root_is_immediately_done(self, graph):
+        a, n = graph
+        mv, lvl, done = B.bfs_batch(a, np.array([n - 1], np.int32))
+        p = mv.to_global()[:, 0]
+        assert p[n - 1] == n - 1 and np.sum(p != B.NO_PARENT) == 1
+        assert bool(np.asarray(done)[0])
+
+    def test_max_levels_truncates(self, grid24):
+        # path graph: after L levels exactly L+1 vertices are reached
+        n = 24
+        e = np.arange(n - 1, dtype=np.int32)
+        rows = np.concatenate([e, e + 1])
+        cols = np.concatenate([e + 1, e])
+        a = DM.from_global_coo(S.LOR, grid24, rows, cols,
+                               jnp.ones(len(rows), jnp.bool_), n, n)
+        mv, lvl, done = B.bfs_batch(a, np.array([0], np.int32),
+                                    max_levels=3)
+        p = mv.to_global()[:, 0]
+        assert int(lvl) == 3
+        assert not bool(np.asarray(done)[0])
+        np.testing.assert_array_equal(np.nonzero(p != B.NO_PARENT)[0],
+                                      np.arange(4))
+        # the truncated prefix matches the full traversal's prefix
+        full = B.bfs(a, 0).to_global()
+        np.testing.assert_array_equal(p[:4], full[:4])
+
+
+# ---------------------------------------------------------------------------
+# mv_stack / mv_column round trip
+# ---------------------------------------------------------------------------
+
+def test_mv_stack_column_roundtrip(grid24, rng):
+    vecs = [dvv.from_global(grid24, COL_AXIS,
+                            rng.normal(size=50).astype(np.float32))
+            for _ in range(3)]
+    mv = mv_stack(vecs)
+    assert mv.width == 3
+    for k, v in enumerate(vecs):
+        np.testing.assert_array_equal(mv_column(mv, k).to_global(),
+                                      v.to_global())
+    with pytest.raises(ValueError, match="identically aligned"):
+        mv_stack([vecs[0], dvv.from_global(grid24, COL_AXIS,
+                                           np.zeros(51, np.float32))])
+
+
+# ---------------------------------------------------------------------------
+# queue + batcher unit semantics (no device work)
+# ---------------------------------------------------------------------------
+
+def _req(kind, payload=None, deadline=None):
+    return serve.Request(kind, payload, serve.ResultHandle(), deadline,
+                         time.monotonic())
+
+
+class TestQueueBatcher:
+    def test_fifo_kind_selective_take(self):
+        q = serve.RequestQueue(max_depth=16)
+        for i, k in enumerate(["a", "b", "a", "a", "b"]):
+            q.put(_req(k, payload=i))
+        out = q.take("a", 2)
+        assert [r.payload for r in out] == [0, 2]
+        # the untaken requests keep their relative order
+        assert [r.payload for r in q.drain()] == [1, 3, 4]
+
+    def test_backpressure_and_doa(self):
+        q = serve.RequestQueue(max_depth=2)
+        q.put(_req("a"))
+        q.put(_req("a"))
+        with pytest.raises(serve.QueueFullError):
+            q.put(_req("a"))
+        with pytest.raises(serve.DeadlineExceededError):
+            q.put(_req("a", deadline=time.monotonic() - 1))
+
+    def test_bucket_for(self):
+        assert serve.bucket_for(1, (1, 2, 4)) == 1
+        assert serve.bucket_for(3, (1, 2, 4)) == 4
+        assert serve.bucket_for(4, (1, 2, 4)) == 4
+        with pytest.raises(ValueError):
+            serve.bucket_for(5, (1, 2, 4))
+
+    def test_batcher_sheds_expired(self):
+        q = serve.RequestQueue(max_depth=16)
+        shed = []
+        live = _req("a")
+        dead = _req("a", deadline=time.monotonic() + 1e-4)
+        q.put(live)
+        q.put(dead)
+        time.sleep(0.005)
+        b = serve.DynamicBatcher(q, (1, 2, 4),
+                                 on_shed=lambda r, why: shed.append(why))
+        batch = b.form()
+        assert [r is live for r in batch.requests] == [True]
+        assert batch.bucket == 1 and shed == ["deadline"]
+        with pytest.raises(serve.DeadlineExceededError):
+            dead.handle.result(timeout=0)
+
+
+# ---------------------------------------------------------------------------
+# GraphService end to end
+# ---------------------------------------------------------------------------
+
+CFG = ServeConfig(buckets=(1, 2, 4), batch_wait_s=0.0)
+
+
+class TestGraphService:
+    def test_bfs_batch_straddles_bucket(self, graph, bfs_plan):
+        """5 concurrent roots with buckets (1,2,4): one width-4 and
+        one width-1 dispatch, results bit-exact per root."""
+        a, n = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        roots = [0, 5, 5, 17, 99]
+        handles = [svc.submit_bfs(r) for r in roots]
+        svc.start()
+        res = [h.result(timeout=600) for h in handles]
+        svc.stop()
+        ref = seq_bfs(a, bfs_plan, roots)
+        for r, out in zip(roots, res):
+            assert out.complete and out.root == r
+            np.testing.assert_array_equal(out.parents, ref[r])
+        assert svc.stats["dispatches"] == 2      # 4+1, not 5
+        assert svc.stats["batches"] == 2
+        keys = {(k.kind, k.bucket) for k in svc.plans.keys()}
+        assert keys == {("bfs", 4), ("bfs", 1)}
+
+    def test_cc_lookups_share_one_label_run(self, graph):
+        a, n = graph
+        labels = C.fastsv(a).to_global()
+        svc = serve.GraphService(a, CFG, autostart=False)
+        verts = [0, 1, 7, 99, n - 1, n - 2]
+        handles = [svc.submit_cc(v) for v in verts]
+        svc.start()
+        out = [h.result(timeout=600) for h in handles]
+        svc.stop()
+        for v, lab in zip(verts, out):
+            assert lab == labels[v]
+        # isolated vertices are their own components
+        assert out[4] != out[0] and out[4] != out[5]
+        # 1 fastsv + 2 gather batches (4+2) — not 6 label runs
+        assert svc.stats["dispatches"] == 3
+
+    def test_spmv_spmsv_coalesce_bit_exact(self, graph, rng):
+        a, n = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        xs = [rng.integers(0, 5, n).astype(np.float32) for _ in range(3)]
+        handles = [svc.submit_spmv(x) for x in xs]
+        # the sparse query densifies and joins the same batch
+        handles.append(svc.submit_spmsv([3, 7], [2.0, 5.0]))
+        xd = np.zeros(n, np.float32)
+        xd[3], xd[7] = 2.0, 5.0
+        xs.append(xd)
+        svc.start()
+        out = [h.result(timeout=600) for h in handles]
+        svc.stop()
+        assert svc.stats["dispatches"] == 1      # all 4 in one SpMM
+        for x, y in zip(xs, out):
+            xv = dvv.from_global(a.grid, COL_AXIS, jnp.asarray(x),
+                                 block=a.tile_n)
+            np.testing.assert_array_equal(
+                y, sp.spmv(S.PLUS_TIMES_F32, a, xv).to_global())
+
+    def test_spmv_semiring_dtype_mismatch(self, graph):
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        with pytest.raises(ValueError, match="dtype"):
+            svc.submit_spmv(np.zeros(a.ncols, np.int32),
+                            sr=S.PLUS_TIMES_I32)
+        svc.start()
+        svc.stop()
+
+    def test_deadline_dead_on_arrival(self, graph):
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        with pytest.raises(serve.DeadlineExceededError):
+            svc.submit_bfs(0, deadline_s=-1.0)
+        svc.start()
+        svc.stop()
+
+    def test_deadline_expired_in_queue_sheds(self, graph):
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        h = svc.submit_bfs(0, deadline_s=1e-4)
+        time.sleep(0.01)
+        svc.start()
+        with pytest.raises(serve.DeadlineExceededError):
+            h.result(timeout=600)
+        svc.stop()
+        assert svc.stats["shed"] == 1 and svc.stats["dispatches"] == 0
+
+    def test_deadline_inflight_partial_result(self, graph, bfs_plan):
+        """A deadline that only affords one level (the EWMA estimate
+        is forced huge) degrades to a partial BfsResult, not an
+        error — and the partial parents are the true 1-level prefix."""
+        a, n = graph
+        cfg = ServeConfig(buckets=(1, 2, 4), bfs_level_est_s=1000.0)
+        svc = serve.GraphService(a, cfg, autostart=False)
+        h = svc.submit_bfs(0, deadline_s=5.0)
+        svc.start()
+        out = h.result(timeout=600)
+        svc.stop()
+        assert not out.complete and out.levels == 1
+        assert svc.stats["partials"] == 1
+        mv, _, _ = B.bfs_batch(a, np.array([0], np.int32), max_levels=1)
+        np.testing.assert_array_equal(out.parents, mv.to_global()[:, 0])
+        # reached set = root + its neighborhood, strictly smaller than
+        # the full traversal
+        full = seq_bfs(a, bfs_plan, [0])[0]
+        assert (np.sum(out.parents != B.NO_PARENT)
+                < np.sum(full != B.NO_PARENT))
+
+    def test_backpressure_typed_error(self, graph):
+        a, _ = graph
+        cfg = ServeConfig(max_queue_depth=2, buckets=(1,))
+        svc = serve.GraphService(a, cfg, autostart=False)
+        svc.submit_cc(0)
+        svc.submit_cc(1)
+        with pytest.raises(serve.QueueFullError):
+            svc.submit_cc(2)
+        svc.start()
+        svc.stop()
+
+    def test_stopped_service_refuses(self, graph):
+        a, _ = graph
+        svc = serve.GraphService(a, CFG)
+        svc.stop()
+        with pytest.raises(serve.ServiceStoppedError):
+            svc.submit_cc(0)
+
+    def test_warmup_prefills_plans(self, graph):
+        a, _ = graph
+        svc = serve.GraphService(a, ServeConfig(buckets=(1, 4)),
+                                 autostart=True)
+        n = svc.warmup(kinds=("bfs", "cc"))
+        assert n == 4
+        assert svc.stats["warmup_dispatches"] == 4
+        assert svc.stats["dispatches"] <= 1      # only the label run
+        assert len(svc.plans) == 4
+        # warm plans mean serving traffic adds only cache hits
+        h = svc.submit_bfs(3)
+        assert h.result(timeout=600).complete
+        svc.stop()
+        assert {(k.kind, k.bucket) for k in svc.plans.keys()} == {
+            ("bfs", 1), ("bfs", 4), ("cc", 1), ("cc", 4)}
+
+    def test_concurrent_submitters(self, graph, bfs_plan):
+        """Clients on many threads against a running service: every
+        handle resolves to the bit-exact per-root answer."""
+        a, n = graph
+        cfg = ServeConfig(buckets=(1, 2, 4), batch_wait_s=0.005)
+        svc = serve.GraphService(a, cfg)
+        roots = [1, 2, 3, 5, 8, 13, 21, 34]
+        results = {}
+        lock = threading.Lock()
+
+        def client(root):
+            out = svc.bfs(root)
+            with lock:
+                results[root] = out
+
+        threads = [threading.Thread(target=client, args=(r,))
+                   for r in roots]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.stop()
+        ref = seq_bfs(a, bfs_plan, roots)
+        for r in roots:
+            np.testing.assert_array_equal(results[r].parents, ref[r])
+        assert svc.stats["results"] == len(roots)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bound: batched dispatches vs sequential per-query
+# ---------------------------------------------------------------------------
+
+def _mixed_workload(svc, a, bfs_plan, labels, nquery, rng, seed_roots):
+    """Submit nquery mixed BFS/CC queries pre-start, serve, and verify
+    bit-exactness vs the sequential baseline. Returns (service
+    dispatches, sequential dispatches)."""
+    n = a.nrows
+    kinds = rng.permutation(np.array(["bfs"] * (nquery // 2)
+                                     + ["cc"] * (nquery - nquery // 2)))
+    picks = rng.choice(seed_roots, size=nquery)
+    handles = [(k, int(v), svc.submit_bfs(int(v)) if k == "bfs"
+                else svc.submit_cc(int(v)))
+               for k, v in zip(kinds, picks)]
+    svc.start()
+    ref = seq_bfs(a, bfs_plan, [v for k, v, _ in handles if k == "bfs"])
+    for k, v, h in handles:
+        out = h.result(timeout=600)
+        if k == "bfs":
+            assert out.complete
+            np.testing.assert_array_equal(out.parents, ref[v])
+        else:
+            assert out == labels[v]
+    svc.stop()
+    # sequential baseline: one device dispatch per query (each bfs()
+    # call is one jitted traversal; each cc lookup one label run
+    # amortizes to at best one gather per query)
+    return svc.stats["dispatches"], nquery
+
+
+def test_mixed_workload_dispatch_reduction(graph, bfs_plan, rng):
+    """Tier-1 version of the acceptance criterion: 96 mixed BFS/CC
+    queries through the service issue >=8x fewer device dispatches
+    than sequential per-query execution, bit-exact."""
+    a, n = graph
+    labels = C.fastsv(a).to_global()
+    cfg = ServeConfig(buckets=(1, 2, 4, 8, 16), batch_wait_s=0.0)
+    svc = serve.GraphService(a, cfg, autostart=False)
+    roots = np.array([0, 5, 17, 42, 99, 150], np.int64)
+    served, sequential = _mixed_workload(svc, a, bfs_plan, labels, 96,
+                                         rng, roots)
+    assert sequential >= 8 * served, (served, sequential)
+
+
+@pytest.mark.slow
+def test_soak_512_query_acceptance(graph, bfs_plan, rng):
+    """The ISSUE acceptance workload: 512 mixed BFS/CC queries, >=8x
+    dispatch reduction, bit-exact vs sequential."""
+    a, n = graph
+    labels = C.fastsv(a).to_global()
+    cfg = ServeConfig(buckets=(1, 2, 4, 8, 16, 32), batch_wait_s=0.0)
+    svc = serve.GraphService(a, cfg, autostart=False)
+    roots = np.array([0, 5, 17, 42, 99, 150, 1, 64], np.int64)
+    served, sequential = _mixed_workload(svc, a, bfs_plan, labels, 512,
+                                         rng, roots)
+    assert sequential >= 8 * served, (served, sequential)
+
+
+@pytest.mark.slow
+def test_soak_open_loop_with_deadlines(graph):
+    """Open-loop pressure: a burst far beyond the queue bound with
+    tight deadlines — every request resolves (result, shed, or
+    backpressure), the service stays up, and counters reconcile."""
+    a, n = graph
+    cfg = ServeConfig(max_queue_depth=32, buckets=(1, 2, 4, 8),
+                      batch_wait_s=0.0)
+    svc = serve.GraphService(a, cfg)
+    svc.warmup(kinds=("cc",), buckets=(8,))
+    outcomes = {"ok": 0, "shed": 0, "full": 0}
+    handles = []
+    for i in range(200):
+        try:
+            handles.append(svc.submit_cc(i % n, deadline_s=2.0))
+        except serve.QueueFullError:
+            outcomes["full"] += 1
+    for h in handles:
+        try:
+            h.result(timeout=600)
+            outcomes["ok"] += 1
+        except serve.DeadlineExceededError:
+            outcomes["shed"] += 1
+    svc.stop()
+    assert outcomes["ok"] + outcomes["shed"] + outcomes["full"] == 200
+    assert outcomes["ok"] > 0
+    assert svc.stats["results"] == outcomes["ok"]
+    assert svc.stats["shed"] == outcomes["shed"]
