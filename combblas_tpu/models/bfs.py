@@ -638,18 +638,24 @@ def _bfs_loop(plan, grid, tile_n, tiers, branches, parents0,
 # ---------------------------------------------------------------------------
 
 @jax.jit
-def bfs_batch(a: dm.DistSpMat, roots, max_levels=None):
+def bfs_batch(a: dm.DistSpMat, roots, max_levels=None, plan=None):
     """W simultaneous BFS traversals in ONE jitted while_loop: the
     frontiers ride the columns of a `DistMultiVec` and every level is
-    one `spmm` with the select2nd-max semiring (≅ BetwCent's batch-of-
-    roots framing, BetwCent.cpp:146; the tall-and-skinny multiply of
-    arXiv:2408.11988).
+    one select2nd-max SpMM over the plan's precomputed chunked edge
+    structure (≅ BetwCent's batch-of-roots framing, BetwCent.cpp:146;
+    the tall-and-skinny multiply of arXiv:2408.11988).
 
     Bit-exact vs per-root `bfs`: per level the dense stepper computes
     y[i] = max over active in-neighbors j of the global column id — and
-    `spmm(SELECT2ND_MAX_I32, a, x)` with x[j, w] = (act ? global col
-    id : MAX-identity) is that exact reduction, column-wise. Columns
-    are independent, so duplicate roots are just repeated columns.
+    the chunked segmented max with x[j, w] = (act ? global col id :
+    MAX-identity) is that exact reduction, column-wise. Columns are
+    independent, so duplicate roots are just repeated columns.
+
+    ``plan`` (a `BfsPlan`, routed or not) supplies the level-invariant
+    row structure so repeated calls never re-derive it per level; when
+    None it is built in-trace (`_plan_bfs_core` — one extra device
+    pass, amortized away by the serve engine, which passes its cached
+    plan).
 
     ``max_levels`` (dynamic int32, no recompile per value; None/0 =
     unbounded) caps the number of levels — the serve engine's deadline
@@ -659,6 +665,15 @@ def bfs_batch(a: dm.DistSpMat, roots, max_levels=None):
     from combblas_tpu.parallel import densemat as dmm
     grid = a.grid
     tile_m, tile_n = a.tile_m, a.tile_n
+    if plan is None:
+        plan = _plan_bfs_core(a)
+    elif plan.sig and plan.sig != (grid.pr, grid.pc, a.cap,
+                                   a.tile_m, a.tile_n):
+        raise ValueError(
+            f"BfsPlan signature {plan.sig} does not match matrix "
+            f"{(grid.pr, grid.pc, a.cap, a.tile_m, a.tile_n)}: the "
+            "plan was built for a different matrix")
+    chunk_len = plan.chunk_len
     roots = jnp.asarray(roots, jnp.int32)
     w = roots.shape[0]
     w_ix = jnp.arange(w, dtype=jnp.int32)
@@ -674,18 +689,38 @@ def bfs_batch(a: dm.DistSpMat, roots, max_levels=None):
     gcol = (jnp.arange(grid.pc, dtype=jnp.int32)[:, None] * tile_n
             + jnp.arange(tile_n, dtype=jnp.int32)[None, :])
 
+    def step(cols_t, starts_t, valid_t, ends_m, nonempty, xb):
+        # one tile's level reduction over the PRECOMPUTED chunked
+        # structure: gather the frontier's global column ids at the
+        # (chunk-ordered) edge columns and segment-max per row —
+        # spmm(SELECT2ND_MAX_I32)'s exact contribution multiset, with
+        # the per-level row_structure() re-derivation gone.
+        xx = xb[0]                                      # (tile_n, W)
+        cg = jnp.clip(cols_t[0, 0], 0, tile_n - 1)
+        contrib = jnp.where(valid_t[0, 0][:, None], xx[cg], _IDENT)
+        st2 = starts_t[0, 0].reshape(chunk_len, 128)
+        y = jax.vmap(lambda col: tl.seg_reduce_pre(
+            S.MAX, col.reshape(chunk_len, 128), st2,
+            ends_m[0, 0], nonempty[0, 0]),
+            in_axes=1, out_axes=1)(contrib)             # (tile_m, W)
+        return S.MAX.axis_reduce(y, COL_AXIS)[None]
+
     def cond(carry):
         _, act, lvl = carry
         return jnp.any(act) & (lvl < ml)
 
     def body(carry):
         parents, act, lvl = carry
-        x = dmm.DistMultiVec(
-            jnp.where(act, gcol[:, :, None], _IDENT), grid, COL_AXIS,
-            a.ncols)
-        y = dmm.spmm(S.SELECT2ND_MAX_I32, a, x)
-        fresh = (y.data != _IDENT) & (parents == NO_PARENT)
-        parents = jnp.where(fresh, y.data, parents)
+        x = jnp.where(act, gcol[:, :, None], _IDENT)
+        y = jax.shard_map(
+            step, mesh=grid.mesh,
+            in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 5
+                     + (P(COL_AXIS, None, None),),
+            out_specs=P(ROW_AXIS, None, None),
+        )(plan.cols_t, plan.starts_t, plan.valid_t, plan.ends_m,
+          plan.nonempty, x)
+        fresh = (y != _IDENT) & (parents == NO_PARENT)
+        parents = jnp.where(fresh, y, parents)
         actn = dmm.mv_realign(
             dmm.DistMultiVec(fresh, grid, ROW_AXIS, a.nrows),
             COL_AXIS, block=tile_n, fill=False).data
@@ -738,9 +773,12 @@ def validate_bfs(edges_r: np.ndarray, edges_c: np.ndarray, n: int,
     # every tree edge must exist in the graph
     tv = np.nonzero(visited & (np.arange(n) != root))[0]
     tp = parents[tv]
-    has_edge = np.asarray(g[tp, tv]).ravel() != 0
-    has_edge |= np.asarray(g[tv, tp]).ravel() != 0
-    assert has_edge.all(), "tree edge not in graph"
+    if tv.size:      # scipy returns a sparse (not dense) result for an
+        #              empty fancy index — an isolated root has no tree
+        #              edges and trivially passes
+        has_edge = np.asarray(g[tp, tv]).ravel() != 0
+        has_edge |= np.asarray(g[tv, tp]).ravel() != 0
+        assert has_edge.all(), "tree edge not in graph"
     # Graph500 spec rule 3: every GRAPH edge connects vertices whose
     # BFS levels differ by at most one (a spanning tree with wrong
     # levels passes the checks above but is not a BFS tree)
@@ -751,6 +789,83 @@ def validate_bfs(edges_r: np.ndarray, edges_c: np.ndarray, n: int,
     nedges = int(comp_mask[edges_r].sum() // 2)  # sym edge list counted once
     return {"visited": int(visited.sum()), "depth": int(level.max()),
             "nedges": nedges}
+
+
+def _row_run_bits(rstarts: jax.Array, nwords: int, r) -> jax.Array:
+    """Packed (nwords,) uint32 bits covering row r's flat slot range
+    [rstarts[r], rstarts[r+1]) of the row-sorted edge order."""
+    lo, hi = rstarts[r], rstarts[r + 1]
+    w32 = jnp.arange(nwords, dtype=jnp.int32) * 32
+    x_hi = jnp.clip(hi - w32, 0, 32)
+    x_lo = jnp.clip(lo - w32, 0, 32)
+
+    def msk(x):
+        full = jnp.uint32(0xFFFFFFFF)
+        part = (jnp.uint32(1) << jnp.clip(x, 0, 31).astype(
+            jnp.uint32)) - jnp.uint32(1)
+        return jnp.where(x >= 32, full, part)
+
+    return msk(x_hi) & ~msk(x_lo)
+
+
+def _extract_parents_bits(plan: BfsPlan, pcand: jax.Array, sb: jax.Array,
+                          cap: int, tile_m: int, npad: int,
+                          fused: bool) -> jax.Array:
+    """Parents (tile_m,) int32 (NO_PARENT where unreached) from one
+    lane's accumulated parent-candidate edge bits: max column id over
+    marked edges, per row. Shared by `bfs_bits` and the batched
+    `bfs_batch_bits` (which maps it over lanes).
+
+    Gather-free fast path (see _plan_parent_extract): the tile is
+    (row, col)-sorted, so the row's max candidate is its HIGHEST
+    pcand bit; one reverse-streamed kernel isolates it and
+    backward-fills the column-id bitplanes to every row's start
+    slot; the start-compact Beneš route then lands start-slot bits
+    at row positions, and the parent ids assemble from bitplanes
+    with dense word ops. Replaces an unpack + chunk-transpose +
+    segmented scan + 4M-row gather pipeline measured at 96 ms/root
+    (of a 118 ms traversal) at scale 22."""
+    if fused:
+        planes = bs.parent_planes_pallas(pcand, sb,
+                                         plan.colbits[0, 0])
+        srt = rt.RoutePlan(rt.tile_masks(plan.srt_masks[0, 0]), cap,
+                           npad, plan.route_compact)
+        nwm = plan.rnon_bits.shape[-1]
+        nbits = planes.shape[0] - 1
+        # planes route in PAIRS through one shared mask stream
+        # (apply_route_pallas_pair) under lax.map, so the executable
+        # holds one kernel instance and each launch amortizes the
+        # mask stream over two planes: 23 single launches measured
+        # 51 ms vs 18 ms paired at scale 22. Odd plane count: the
+        # last pair duplicates the final plane.
+        npl = planes.shape[0]
+        if rt.route_pallas_ok(srt, extra_arrays=2):
+            # pair kernel holds 2 in + 2 out full planes + masks
+            if npl % 2:
+                planes = jnp.concatenate([planes, planes[-1:]])
+            pairs = planes.reshape(-1, 2, planes.shape[-1])
+            routed = lax.map(
+                lambda w2: rt.apply_route_pallas_pair(srt, w2)[:, :nwm],
+                pairs).reshape(-1, nwm)[:npl]
+        else:
+            routed = lax.map(
+                lambda w: rt.apply_route_pallas(srt, w)[:nwm], planes)
+        hasc = routed[nbits] & plan.rnon_bits[0, 0]
+        parents = jnp.zeros((tile_m,), jnp.int32)
+        for b in range(nbits):
+            pb = rt.unpack_bits(routed[b] & hasc, tile_m)
+            parents = parents | (pb.astype(jnp.int32) << b)
+        hc8 = rt.unpack_bits(hasc, tile_m)
+        return jnp.where(hc8 > 0, parents, NO_PARENT)
+    pc8 = rt.unpack_bits(pcand, cap)
+    chunk_len = plan.cols_t.shape[-1] // 128
+    eb = tl.to_chunked(pc8, fill=0).reshape(-1)
+    e_act = (eb > 0) & plan.valid_t[0, 0]
+    contrib = jnp.where(e_act, plan.cols_t[0, 0], _IDENT)
+    y = tl.seg_reduce_pre(S.MAX, contrib.reshape(chunk_len, 128),
+                          plan.starts_t[0, 0].reshape(chunk_len, 128),
+                          plan.ends_m[0, 0], plan.nonempty[0, 0])
+    return jnp.where(y != _IDENT, y, NO_PARENT)
 
 
 @jax.jit
@@ -806,19 +921,7 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     root = jnp.asarray(root, jnp.int32)
 
     def row_run_bits(r):
-        """Packed bits covering row r's flat slot range."""
-        lo, hi = rstarts[r], rstarts[r + 1]
-        w32 = jnp.arange(nwords, dtype=jnp.int32) * 32
-        x_hi = jnp.clip(hi - w32, 0, 32)
-        x_lo = jnp.clip(lo - w32, 0, 32)
-
-        def msk(x):
-            full = jnp.uint32(0xFFFFFFFF)
-            part = (jnp.uint32(1) << jnp.clip(x, 0, 31).astype(
-                jnp.uint32)) - jnp.uint32(1)
-            return jnp.where(x >= 32, full, part)
-
-        return msk(x_hi) & ~msk(x_lo)
+        return _row_run_bits(rstarts, nwords, r)
 
     # NB round-4 lesson (measured, scale 22): a direction-optimizing
     # sparse/dense hybrid of this loop is a LOSS on this hardware —
@@ -868,60 +971,142 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     _, _, pcand, _, _ = lax.while_loop(
         cond, body, (new0, visited0, pcand0, flag0, jnp.int32(0)))
 
-    # parent extraction: max column id over marked edges, per row.
-    # Gather-free fast path (see _plan_parent_extract): the tile is
-    # (row, col)-sorted, so the row's max candidate is its HIGHEST
-    # pcand bit; one reverse-streamed kernel isolates it and
-    # backward-fills the column-id bitplanes to every row's start
-    # slot; the start-compact Beneš route then lands start-slot bits
-    # at row positions, and the parent ids assemble from bitplanes
-    # with dense word ops. Replaces an unpack + chunk-transpose +
-    # segmented scan + 4M-row gather pipeline measured at 96 ms/root
-    # (of a 118 ms traversal) at scale 22.
-    if fused and plan.colbits is not None:
-        planes = bs.parent_planes_pallas(pcand, sb,
-                                         plan.colbits[0, 0])
-        srt = rt.RoutePlan(rt.tile_masks(plan.srt_masks[0, 0]), cap,
-                           npad, plan.route_compact)
-        nwm = plan.rnon_bits.shape[-1]
-        nbits = planes.shape[0] - 1
-        # planes route in PAIRS through one shared mask stream
-        # (apply_route_pallas_pair) under lax.map, so the executable
-        # holds one kernel instance and each launch amortizes the
-        # mask stream over two planes: 23 single launches measured
-        # 51 ms vs 18 ms paired at scale 22. Odd plane count: the
-        # last pair duplicates the final plane.
-        npl = planes.shape[0]
-        if rt.route_pallas_ok(srt, extra_arrays=2):
-            # pair kernel holds 2 in + 2 out full planes + masks
-            if npl % 2:
-                planes = jnp.concatenate([planes, planes[-1:]])
-            pairs = planes.reshape(-1, 2, planes.shape[-1])
-            routed = lax.map(
-                lambda w2: rt.apply_route_pallas_pair(srt, w2)[:, :nwm],
-                pairs).reshape(-1, nwm)[:npl]
-        else:
-            routed = lax.map(
-                lambda w: rt.apply_route_pallas(srt, w)[:nwm], planes)
-        hasc = routed[nbits] & plan.rnon_bits[0, 0]
-        parents = jnp.zeros((tile_m,), jnp.int32)
-        for b in range(nbits):
-            pb = rt.unpack_bits(routed[b] & hasc, tile_m)
-            parents = parents | (pb.astype(jnp.int32) << b)
-        hc8 = rt.unpack_bits(hasc, tile_m)
-        parents = jnp.where(hc8 > 0, parents, NO_PARENT)
-    else:
-        pc8 = rt.unpack_bits(pcand, cap)
-        chunk_len = plan.cols_t.shape[-1] // 128
-        eb = tl.to_chunked(pc8, fill=0).reshape(-1)
-        e_act = (eb > 0) & plan.valid_t[0, 0]
-        contrib = jnp.where(e_act, plan.cols_t[0, 0], _IDENT)
-        y = tl.seg_reduce_pre(S.MAX, contrib.reshape(chunk_len, 128),
-                              plan.starts_t[0, 0].reshape(chunk_len, 128),
-                              plan.ends_m[0, 0], plan.nonempty[0, 0])
-        parents = jnp.where(y != _IDENT, y, NO_PARENT)
+    # parent extraction: max column id over marked edges, per row
+    # (shared with bfs_batch_bits — see _extract_parents_bits).
+    parents = _extract_parents_bits(
+        plan, pcand, sb, cap, tile_m, npad,
+        fused=fused and plan.colbits is not None)
     parents = parents.at[root].set(root)
     return dv.DistVec(parents[None, :], a.grid, ROW_AXIS, a.nrows)
+
+
+def bits_batch_ok(a: dm.DistSpMat, plan: BfsPlan | None) -> bool:
+    """Whether the bitplane batched BFS applies: single-tile grid,
+    routed plan, verified pattern symmetry (the same guards as
+    `bfs_bits` — the whole algorithm rests on the col-order==row-order
+    bit identity)."""
+    return (plan is not None and a.grid.pr == 1 and a.grid.pc == 1
+            and plan.route_masks is not None and plan.symmetric)
+
+
+def bfs_batch_bits(a: dm.DistSpMat, roots, max_levels=None, plan=None):
+    """Batched multi-source BFS with PACKED-BIT frontiers: lane w of
+    an (nwords, W) uint32 bitplane matrix is root w's edge-space
+    frontier, so one shared Beneš route + one lane-parallel segmented
+    OR fill advances ALL W roots one level — 1 bit of frontier traffic
+    per root per edge slot where `bfs_batch` moves a full i32 column
+    (the CombBLAS-2.0 batched-traversal win, arXiv:2106.14402, on the
+    `bfs_bits` edge-space machinery).
+
+    Host-level wrapper: validates roots (any root outside [0, n) is a
+    ValueError), then dispatches to the jitted bitplane core when
+    `bits_batch_ok` holds, else falls back to dense `bfs_batch`
+    (unrouted plan, pattern-asymmetric matrix, or a mesh — the exact
+    guards `bfs_bits` enforces by raising; a batch endpoint degrades
+    instead).
+
+    Returns the `bfs_batch` triple (parents r-aligned DistMultiVec,
+    levels, done (W,) bool), with ``levels`` PER-LANE on the bits
+    path: lane w's count of levels actually advanced (its root's
+    truncated eccentricity), a (W,) int32 — the dense fallback
+    broadcasts its scalar wave count. Parents are a valid BFS tree
+    per lane (validate_bfs) with levels identical to per-root `bfs`;
+    the parent CHOICE may differ (both pick a max-id parent, over
+    differently-ordered candidate sets)."""
+    roots_np = np.asarray(roots, np.int64)
+    if roots_np.ndim != 1 or roots_np.size == 0:
+        raise ValueError("roots must be a non-empty 1-D array")
+    if roots_np.min() < 0 or roots_np.max() >= a.nrows:
+        bad = roots_np[(roots_np < 0) | (roots_np >= a.nrows)]
+        raise ValueError(f"roots {bad.tolist()} outside [0, {a.nrows})")
+    roots32 = jnp.asarray(roots_np, jnp.int32)
+    if not bits_batch_ok(a, plan):
+        mv, lvl, done = bfs_batch(a, roots32, max_levels, plan=plan)
+        return mv, jnp.broadcast_to(lvl, done.shape), done
+    if plan.sig and plan.sig != (a.grid.pr, a.grid.pc, a.cap,
+                                 a.tile_m, a.tile_n):
+        raise ValueError(
+            f"BfsPlan signature {plan.sig} does not match matrix "
+            f"{(a.grid.pr, a.grid.pc, a.cap, a.tile_m, a.tile_n)}: the "
+            "plan was built for a different matrix")
+    if max_levels is None:
+        ml = jnp.int32(_SAT)
+    else:
+        ml = jnp.asarray(max_levels, jnp.int32)
+        ml = jnp.where(ml <= 0, jnp.int32(_SAT), ml)
+    return _bfs_batch_bits_core(a, plan, roots32, ml)
+
+
+@jax.jit
+def _bfs_batch_bits_core(a: dm.DistSpMat, plan: BfsPlan, roots, ml):
+    """The bitplane wave loop (see bfs_batch_bits). One while_loop
+    iteration = one level for every lane: multi-lane route, AND with
+    the live-slot mask, lane-parallel segment fill, frontier/visited/
+    parent-candidate updates — all (nwords, W) word arithmetic. A lane
+    whose frontier empties goes inert (all-zero bits route to all-zero)
+    while the wave serves the rest; per-lane level counters stop with
+    it."""
+    from combblas_tpu.parallel import densemat as dmm
+    cap, tile_m = a.cap, a.tile_m
+    npad = rt.mask_npad(_mask_words(plan.route_masks), plan.route_compact)
+    nwords = npad >> 5
+    rp = rt.RoutePlan(rt.tile_masks(plan.route_masks[0, 0]), cap, npad,
+                      plan.route_compact)
+    sb = plan.starts_bits[0, 0]
+    vb = plan.valid_bits[0, 0]
+    rstarts = plan.rstarts[0, 0]
+    w = roots.shape[0]
+
+    # lane seeds: root w's row-run bits in lane w (an isolated root's
+    # run is empty — the lane is born inert, exactly the dense path's
+    # immediately-empty frontier)
+    new0 = jax.vmap(lambda r: _row_run_bits(rstarts, nwords, r),
+                    out_axes=1)(roots)               # (nwords, W)
+    visited0 = new0
+    pcand0 = jnp.zeros_like(new0)
+    lanelvl0 = jnp.zeros((w,), jnp.int32)
+
+    def cond(carry):
+        new, _, _, _, lvl = carry
+        # tile_m cap: a BFS level count can never exceed the vertex
+        # count — device-side safety net against a runaway loop
+        return jnp.any(new != 0) & (lvl < ml) & (lvl < jnp.int32(tile_m))
+
+    def body(carry):
+        new, visited, pcand, lanelvl, lvl = carry
+        eact = rt.apply_route_multi_best(rp, new)
+        hit = eact & vb[:, None]
+        reached = bs.seg_or_fill_multi_best(hit, sb)
+        new2 = reached & ~visited & vb[:, None]
+        adv = jnp.any(new2 != 0, axis=0)             # (W,) lane advanced?
+        return (new2, visited | new2, pcand | (hit & new2),
+                lanelvl + adv.astype(jnp.int32), lvl + 1)
+
+    new, _, pcand, lanelvl, _ = lax.while_loop(
+        cond, body, (new0, visited0, pcand0, lanelvl0, jnp.int32(0)))
+    # per-lane done: complete iff the lane's frontier was empty when
+    # the wave stopped (matches bfs_batch's per-column act check)
+    done = ~jnp.any(new != 0, axis=0)
+
+    # parent extraction per lane, via the shared single-lane helper:
+    # vmap on the XLA fallback (seg_reduce_pre is vmap-safe), lax.map
+    # over lanes on the Pallas fast path (kernels don't vmap).
+    fused = (nwords % 128 == 0 and rt.route_pallas_ok(rp, extra_arrays=1)
+             and plan.colbits is not None)
+    if fused:
+        parents = lax.map(
+            lambda pcw: _extract_parents_bits(plan, pcw, sb, cap,
+                                              tile_m, npad, True),
+            pcand.T).T                               # (tile_m, W)
+    else:
+        parents = jax.vmap(
+            lambda pcw: _extract_parents_bits(plan, pcw, sb, cap,
+                                              tile_m, npad, False),
+            in_axes=1, out_axes=1)(pcand)
+    w_ix = jnp.arange(w, dtype=jnp.int32)
+    parents = parents.at[roots, w_ix].set(roots)
+    return (dmm.DistMultiVec(parents[None], a.grid, ROW_AXIS, a.nrows),
+            lanelvl, done)
 
 
 def _bits_mesh_ok(a: dm.DistSpMat, plan: BfsPlan) -> bool:

@@ -110,6 +110,79 @@ _RESERVOIR = 2048
 _QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
 
 
+class P2Quantile:
+    """Streaming quantile estimate via the P² algorithm (Jain &
+    Chlamtac 1985): five markers whose heights approximate the
+    [min, p/2, p, (1+p)/2, max] quantile curve, adjusted per
+    observation with a parabolic (fallback linear) step. O(1) memory
+    and update; exact below five samples. Unlike the sliding reservoir
+    this summarizes the FULL run, so unbounded soaks keep honest tail
+    percentiles."""
+
+    __slots__ = ("p", "_n", "_q", "_npos", "_dn")
+
+    def __init__(self, p: float):
+        self.p = p
+        self._n = 0          # samples seen
+        self._q = []         # marker heights (sorted)
+        self._npos = [1, 2, 3, 4, 5]            # actual positions
+        self._dn = (0.0, p / 2, p, (1 + p) / 2, 1.0)  # position incs
+
+    def observe(self, x: float) -> None:
+        self._n += 1
+        if self._n <= 5:
+            bisect.insort(self._q, x)
+            return
+        q, npos = self._q, self._npos
+        if x < q[0]:
+            q[0] = x
+            k = 0
+        elif x >= q[4]:
+            q[4] = x
+            k = 3
+        else:
+            k = 0
+            while x >= q[k + 1]:
+                k += 1
+        for i in range(k + 1, 5):
+            npos[i] += 1
+        # desired positions drift by dn per sample; nudge the three
+        # interior markers toward them by at most one slot
+        for i in (1, 2, 3):
+            want = 1 + (self._n - 1) * self._dn[i]
+            d = want - npos[i]
+            if ((d >= 1 and npos[i + 1] - npos[i] > 1)
+                    or (d <= -1 and npos[i - 1] - npos[i] < -1)):
+                d = 1 if d >= 1 else -1
+                qn = self._parabolic(i, d)
+                if not (q[i - 1] < qn < q[i + 1]):
+                    qn = self._linear(i, d)
+                q[i] = qn
+                npos[i] += d
+
+    def _parabolic(self, i: int, d: int) -> float:
+        q, n = self._q, self._npos
+        return q[i] + d / (n[i + 1] - n[i - 1]) * (
+            (n[i] - n[i - 1] + d) * (q[i + 1] - q[i])
+            / (n[i + 1] - n[i])
+            + (n[i + 1] - n[i] - d) * (q[i] - q[i - 1])
+            / (n[i] - n[i - 1]))
+
+    def _linear(self, i: int, d: int) -> float:
+        q, n = self._q, self._npos
+        return q[i] + d * (q[i + d] - q[i]) / (n[i + d] - n[i])
+
+    def value(self) -> float | None:
+        if self._n == 0:
+            return None
+        if self._n <= 5:
+            # exact nearest-rank while the markers are raw samples
+            i = min(self._n - 1,
+                    max(0, math.ceil(self.p * self._n) - 1))
+            return self._q[i]
+        return self._q[2]
+
+
 def _percentiles(samples: list) -> dict:
     """Nearest-rank p50/p90/p99 of a raw-sample list (empty -> None)."""
     if not samples:
@@ -128,7 +201,9 @@ class Histogram:
     `count`). Tracks sum/count/min/max, plus a bounded raw-sample
     window (`_RESERVOIR` most recent) from which `series()` reports
     p50/p90/p99 — so latency percentiles are readable straight from a
-    snapshot without bucket interpolation."""
+    snapshot without bucket interpolation. `use_sketch(True)` switches
+    the percentile source to streaming P² sketches (full-run, O(1)
+    memory) for this metric; the reservoir stays the default."""
 
     def __init__(self, name: str, help: str = "",
                  bounds: tuple = _DEFAULT_BOUNDS):
@@ -136,7 +211,16 @@ class Histogram:
         self.help = help
         self.bounds = tuple(sorted(bounds))
         self._series: dict[tuple, dict] = {}
+        self._sketch = False
         self._lock = threading.Lock()
+
+    def use_sketch(self, on: bool = True) -> None:
+        """Toggle P² streaming quantiles for this metric. Sketches
+        start accumulating at the NEXT observe; series already holding
+        sketch state keep it (toggling off just stops reporting from
+        it)."""
+        with self._lock:
+            self._sketch = bool(on)
 
     def observe(self, value: float, **labels) -> None:
         if not _trace._ENABLED:
@@ -157,6 +241,13 @@ class Histogram:
                 samples.append(value)
             else:
                 samples[s["count"] % _RESERVOIR] = value
+            if self._sketch:
+                sk = s.get("sketch")
+                if sk is None:
+                    sk = s["sketch"] = {
+                        q: P2Quantile(p) for q, p in _QUANTILES}
+                for est in sk.values():
+                    est.observe(value)
             s["sum"] += value
             s["count"] += 1
             s["min"] = min(s["min"], value)
@@ -168,6 +259,10 @@ class Histogram:
             if s is None:
                 return None
             # copy under the lock; format outside it
+            pcts = None
+            if self._sketch and "sketch" in s:
+                pcts = {q: est.value()
+                        for q, est in s["sketch"].items()}
             s = {**s, "buckets": list(s["buckets"]),
                  "samples": list(s["samples"])}
         # cumulative buckets on read (updates stay O(1) per observe)
@@ -176,8 +271,11 @@ class Histogram:
             tot += b
             cum.append(tot)
         samples = s.pop("samples")
+        s.pop("sketch", None)
+        if pcts is None:
+            pcts = _percentiles(samples)
         return {**s, "buckets": cum, "bounds": list(self.bounds),
-                **_percentiles(samples)}
+                **pcts}
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -516,6 +516,177 @@ def parent_planes_pallas(pcand: jax.Array, starts: jax.Array,
     return out[:, :r].reshape(nplanes, -1)
 
 
+# --------------------------------------------------------------------------
+# Multi-lane variants: the same segmented OR networks over a bitplane
+# MATRIX (nwords, W) — lane w is an independent packed bit vector (one
+# BFS root's frontier in the batched traversal), all lanes sharing ONE
+# static segment layout. The Kogge-Stone stages broadcast the (nwords,)
+# no-boundary masks over the lane axis, so W roots cost one wave of
+# word arithmetic instead of W scans.
+# --------------------------------------------------------------------------
+
+def _shift_up_multi(x: jax.Array, d: int) -> jax.Array:
+    """_shift_up along axis 0 of an (nwords, W) lane matrix."""
+    wd, bd = d // 32, d % 32
+    if wd:
+        x = jnp.concatenate(
+            [jnp.zeros((wd,) + x.shape[1:], x.dtype), x[:-wd]])
+    if bd:
+        prev = jnp.concatenate(
+            [jnp.zeros((1,) + x.shape[1:], x.dtype), x[:-1]])
+        x = (x << bd) | (prev >> (32 - bd))
+    return x
+
+
+def _shift_down_multi(x: jax.Array, d: int) -> jax.Array:
+    """_shift_down along axis 0 of an (nwords, W) lane matrix."""
+    wd, bd = d // 32, d % 32
+    if wd:
+        x = jnp.concatenate(
+            [x[wd:], jnp.zeros((wd,) + x.shape[1:], x.dtype)])
+    if bd:
+        nxt = jnp.concatenate(
+            [x[1:], jnp.zeros((1,) + x.shape[1:], x.dtype)])
+        x = (x >> bd) | (nxt << (32 - bd))
+    return x
+
+
+def seg_or_scan_bits_multi(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Lane-parallel inclusive segmented OR scan. ``x``: (nwords, W)
+    uint32 lane matrix; ``starts``: (nwords,) shared segment starts."""
+    n = int(x.shape[0]) * 32
+    y = x
+    nb = (~starts)[:, None]           # shared mask, (nwords, 1)
+    d = 1
+    while d < n:
+        y = y | (nb & _shift_up_multi(y, d))
+        nb = nb & _shift_up_multi(nb, d)
+        d <<= 1
+    return y
+
+
+def seg_or_fill_bits_multi(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Lane-parallel segment-wide OR (seg_or_fill_bits over every lane
+    of an (nwords, W) matrix in one pass)."""
+    n = int(x.shape[0]) * 32
+    y = seg_or_scan_bits_multi(x, starts)
+    nb = _shift_down_multi((~starts)[:, None], 1)
+    d = 1
+    while d < n:
+        y = y | (nb & _shift_down_multi(y, d))
+        nb = nb & _shift_down_multi(nb, d)
+        d <<= 1
+    return y
+
+
+def _fill_fwd_multi_kernel(x_ref, s_ref, o_ref, carry_ref, *, nbits_blk):
+    """Forward fill pass on a (lane, block) grid cell. Blocks stream
+    innermost (the TPU grid iterates the LAST dim fastest), so the
+    carry word is sequential within each lane and resets at each
+    lane's first block."""
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(1)
+    x = x_ref[0]
+    s = s_ref[...]
+    y, m = _block_or_scan(x, s, nbits_blk, up=True)
+
+    @pl.when(t == 0)
+    def _init():
+        carry_ref[0, 0] = jnp.uint32(0)
+
+    y = y | (m & carry_ref[0, 0])
+    o_ref[0] = y
+    last = y[-1, -1] >> 31             # bit 31 of the final word
+    carry_ref[0, 0] = jnp.where(last > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+
+
+def _fill_bwd_multi_kernel(y_ref, s_ref, o_ref, carry_ref, *, nbits_blk):
+    """Backward fill pass on a (lane, block) grid cell (blocks arrive
+    reverse-streamed via the index map); per-lane carry reset."""
+    import jax.experimental.pallas as pl
+
+    t = pl.program_id(1)
+    y0 = y_ref[0]
+    s = s_ref[...]
+    y, m = _block_or_scan(y0, s, nbits_blk, up=False)
+
+    @pl.when(t == 0)
+    def _init():
+        carry_ref[0, 0] = jnp.uint32(0)
+
+    y = y | (m & carry_ref[0, 0])
+    o_ref[0] = y
+    first = (y[0, 0] & ~s[0, 0]) & jnp.uint32(1)
+    carry_ref[0, 0] = jnp.where(first > 0, jnp.uint32(0xFFFFFFFF),
+                                jnp.uint32(0))
+
+
+def seg_or_fill_multi_pallas(x: jax.Array, starts: jax.Array,
+                             interpret: bool = False) -> jax.Array:
+    """seg_or_fill_bits_multi as two block-streamed Pallas passes on a
+    (W, nblk) grid — one launch serves every lane, with the shared
+    ``starts`` block fetched once per grid cell. ``x``: (nwords, W)
+    with nwords a multiple of 128; ``starts``: (nwords,)."""
+    import functools
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from combblas_tpu.ops.route import _sds
+
+    nwords, w = int(x.shape[0]), int(x.shape[1])
+    r = nwords // 128
+    blr = min(_BLR, r)
+    nblk = -(-r // blr)
+    padr = nblk * blr
+    x3 = x.T.reshape(w, r, 128)
+    s2 = starts.reshape(r, 128)
+    if padr != r:
+        x3 = jnp.pad(x3, ((0, 0), (0, padr - r), (0, 0)))
+        s2 = jnp.pad(s2, ((0, padr - r), (0, 0)),
+                     constant_values=jnp.uint32(0xFFFFFFFF))
+    nbits_blk = blr * 128 * 32
+
+    lane = pl.BlockSpec((1, blr, 128), lambda p, t: (p, t, 0),
+                        memory_space=pltpu.VMEM)
+    shared = pl.BlockSpec((blr, 128), lambda p, t: (t, 0),
+                          memory_space=pltpu.VMEM)
+    fwd = pl.pallas_call(
+        functools.partial(_fill_fwd_multi_kernel, nbits_blk=nbits_blk),
+        grid=(w, nblk),
+        in_specs=[lane, shared],
+        out_specs=lane,
+        out_shape=_sds((w, padr, 128), jnp.uint32, x),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(x3, s2)
+
+    lane_r = pl.BlockSpec((1, blr, 128),
+                          lambda p, t, n=nblk: (p, n - 1 - t, 0),
+                          memory_space=pltpu.VMEM)
+    shared_r = pl.BlockSpec((blr, 128),
+                            lambda p, t, n=nblk: (n - 1 - t, 0),
+                            memory_space=pltpu.VMEM)
+    bwd = pl.pallas_call(
+        functools.partial(_fill_bwd_multi_kernel, nbits_blk=nbits_blk),
+        grid=(w, nblk),
+        in_specs=[lane_r, shared_r],
+        out_specs=lane_r,
+        out_shape=_sds((w, padr, 128), jnp.uint32, x),
+        scratch_shapes=[pltpu.SMEM((1, 1), jnp.uint32)],
+        interpret=interpret,
+    )(fwd, s2)
+    return bwd[:, :r].reshape(w, -1).T
+
+
+def seg_or_fill_multi_best(x: jax.Array, starts: jax.Array) -> jax.Array:
+    """Dispatch: Pallas on TPU when the layout allows, else XLA."""
+    from combblas_tpu.ops import pallas_kernels as pk
+    if pk.enabled() and x.shape[0] % 128 == 0 and x.shape[0] >= 128:
+        return seg_or_fill_multi_pallas(x, starts)
+    return seg_or_fill_bits_multi(x, starts)
+
+
 def row_end_bits(y: jax.Array, starts: jax.Array, nbits: int) -> jax.Array:
     """Bits of ``y`` at segment END slots (slot before the next start,
     or the final valid slot), other slots zeroed. ``nbits`` = number

@@ -686,6 +686,53 @@ def apply_route_best(rp: RoutePlan, words: jax.Array) -> jax.Array:
     return apply_route(rp, words)
 
 
+def apply_route_multi(rp: RoutePlan, words: jax.Array) -> jax.Array:
+    """Route an (npad/32, W) lane MATRIX of packed bit-planes through
+    the network in one pass — every lane traverses the same delta-swap
+    stages, with each stage's mask decompacted ONCE and broadcast over
+    the lane axis. Lane w of the output is bit-identical to
+    apply_route(rp, words[:, w])."""
+    m = rp.npad.bit_length() - 1
+    w = words.shape[1]
+    for t in range(rp.nstages):
+        s = _stride(t, m, rp.npad)
+        if rp.compact:
+            mt = _decompact_stage(rp.masks[t].reshape(-1),
+                                  s.bit_length() - 1, rp.npad)
+        else:
+            mt = rp.masks[t].reshape(-1)
+        if s >= 32:
+            d = s >> 5
+            w2 = words.reshape(-1, 2, d, w)
+            a, b = w2[:, 0], w2[:, 1]
+            ml = mt.reshape(-1, 2, d)[:, 0, :, None]
+            delta = (a ^ b) & ml
+            words = jnp.stack([a ^ delta, b ^ delta],
+                              axis=1).reshape(-1, w)
+        else:
+            mt = mt[:, None]
+            delta = ((words >> s) ^ words) & mt
+            words = words ^ delta ^ (delta << s)
+    return words
+
+
+def apply_route_multi_best(rp: RoutePlan, words: jax.Array) -> jax.Array:
+    """Lane-matrix route dispatch: on TPU (layout permitting) pair
+    lanes through the VMEM-resident pair kernel under lax.map — each
+    launch shares one mask stream between two planes — else the XLA
+    lane-broadcast stage loop. Bit-identical either way."""
+    w = int(words.shape[1])
+    if w >= 2 and route_pallas_ok(rp, extra_arrays=2):
+        lanes = words.T                      # (W, nwords)
+        if w % 2:
+            lanes = jnp.concatenate([lanes, lanes[-1:]])
+        pairs = lanes.reshape(-1, 2, lanes.shape[1])
+        out = jax.lax.map(lambda p: apply_route_pallas_pair(rp, p),
+                          pairs)
+        return out.reshape(-1, out.shape[-1])[:w].T
+    return apply_route_multi(rp, words)
+
+
 def pack_bits(bits: jax.Array, npad: int) -> jax.Array:
     """(n,) bool/int8 -> (npad/32,) uint32, little-endian bit order
     (bit i of word w = slot 32w+i), zero-padded."""

@@ -6,9 +6,13 @@ off the queue (batcher.py), pads it to the jit bucket, runs ONE
 compiled executable for the whole batch (plans.py), and fans results
 back out to the per-request handles. Query kinds:
 
-* **bfs** — roots ride the columns of `models.bfs.bfs_batch` (one
-  while_loop traversal for the whole batch, bit-exact vs per-root
-  `bfs`). Deadlines degrade gracefully: the level budget is
+* **bfs** — eligible matrices (single-tile, routed, pattern-
+  symmetric; cfg.bfs_bits / COMBBLAS_TPU_SERVE_BITS=0) batch through
+  `models.bfs.bfs_batch_bits`: packed-bit bitplane frontiers, 32
+  roots per uint32 word, buckets lane-aligned to 32. Everything else
+  rides the columns of `models.bfs.bfs_batch` (one while_loop
+  traversal for the whole batch, bit-exact vs per-root `bfs`).
+  Deadlines degrade gracefully on both paths: the level budget is
   min-remaining-time / EWMA-per-level-estimate, and roots whose
   traversal was truncated return `BfsResult(complete=False)` with the
   partial parents rather than an error.
@@ -29,6 +33,7 @@ obs is enabled — tests and callers read `stats`, dashboards read obs.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 from functools import partial
@@ -44,12 +49,16 @@ from combblas_tpu.models import cc as _cc
 from combblas_tpu.ops.semiring import PLUS_TIMES_F32, Semiring
 from combblas_tpu.parallel import densemat as dmm
 from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
-from combblas_tpu.serve.batcher import Batch, DynamicBatcher
+from combblas_tpu.serve.batcher import Batch, DynamicBatcher, bucket_for
 from combblas_tpu.serve.plans import PlanCache, PlanKey
 from combblas_tpu.serve.queue import (
-    Request, RequestQueue, ResultHandle, ServiceStoppedError,
+    DeadlineExceededError, Request, RequestQueue, ResultHandle,
+    ServiceStoppedError,
 )
 from combblas_tpu.utils.config import ServeConfig
+
+#: packed-bit BFS lane width: one uint32 frontier word carries 32 roots
+_LANE_W = 32
 
 _queue_depth = obs.gauge("serve.queue_depth", "requests waiting")
 _occupancy = obs.histogram(
@@ -93,7 +102,7 @@ class GraphService:
     """
 
     def __init__(self, a, config: Optional[ServeConfig] = None, *,
-                 autostart: bool = True):
+                 plan=None, autostart: bool = True):
         self.a = a
         self.cfg = config or ServeConfig()
         self.queue = RequestQueue(self.cfg.max_queue_depth)
@@ -109,6 +118,18 @@ class GraphService:
         self._stats_lock = threading.Lock()
         self._mesh = (a.grid.pr, a.grid.pc)
         self._bfs_level_est = self.cfg.bfs_level_est_s
+        # per-kind EWMA dispatch-cost estimates (shed-before-dispatch
+        # for cc/spmv; BFS degrades via the level budget instead)
+        self._cost_est: dict = {}
+        # BFS structure plans: resolved lazily on first BFS (routing is
+        # host-side work best kept off the constructor). ``plan`` lets
+        # callers hand in a prebuilt BfsPlan (routed or not).
+        self._base_plan = plan
+        self._bits_plan = None
+        self._plans_resolved = False
+        self._plan_lock = threading.Lock()
+        if self.cfg.latency_sketch:
+            _latency.use_sketch(True)
         self._cc_labels = None          # lazy device label vector
         self._cc_lock = threading.Lock()
         self._stop = threading.Event()
@@ -246,7 +267,41 @@ class GraphService:
                     if not r.handle.done():
                         r.handle.set_exception(e)
 
+    def _shed_predicted(self, batch: Batch) -> Optional[Batch]:
+        """Shed-before-dispatch (cc/spmv): requests whose remaining
+        deadline is below the kind's EWMA dispatch-cost estimate are
+        doomed — joining the dispatch would only burn device time and
+        delay the rest of the queue. They fail with
+        DeadlineExceededError NOW; returns the surviving batch (None
+        when everything shed, so the dispatch is skipped entirely).
+        BFS is exempt: its level budget degrades to a partial result
+        instead of an error."""
+        est = self._cost_est.get(batch.kind)
+        if est is None:
+            return batch
+        now = time.monotonic()
+        keep = []
+        for r in batch.requests:
+            remain = r.remaining(now)
+            if remain is not None and remain < est:
+                r.handle.set_exception(DeadlineExceededError(
+                    f"predicted {batch.kind} dispatch cost {est:.4f}s "
+                    f"exceeds remaining deadline {remain:.4f}s"))
+                self._note_shed(r, "predicted")
+            else:
+                keep.append(r)
+        if not keep:
+            return None
+        if len(keep) == len(batch.requests):
+            return batch
+        return Batch(batch.kind, keep,
+                     bucket_for(len(keep), self.cfg.buckets))
+
     def _execute(self, batch: Batch) -> None:
+        if batch.kind != "bfs" and self.cfg.predictive_shed:
+            batch = self._shed_predicted(batch)
+            if batch is None:
+                return
         with obs.span("serve.batch", kind=batch.kind,
                       width=len(batch.requests), bucket=batch.bucket):
             if batch.kind == "bfs":
@@ -293,16 +348,59 @@ class GraphService:
             return arr
         return np.concatenate([arr, np.repeat(arr[:1], pad, axis=0)])
 
+    def _bfs_structure(self):
+        """Resolve (base_plan, bits_plan) once, lazily. The bits plan
+        exists iff the packed-bit batch path is wanted
+        (cfg.bfs_bits, COMBBLAS_TPU_SERVE_BITS env) AND eligible
+        (single-tile mesh, routed, verified pattern-symmetric —
+        `models.bfs.bits_batch_ok`)."""
+        with self._plan_lock:
+            if not self._plans_resolved:
+                mode = self.cfg.bfs_bits
+                if os.environ.get("COMBBLAS_TPU_SERVE_BITS", "1") == "0":
+                    mode = "off"
+                if mode not in ("auto", "on", "off"):
+                    raise ValueError(f"bfs_bits={mode!r}: expected "
+                                     "'auto', 'on', or 'off'")
+                if mode != "off" and self._mesh == (1, 1):
+                    cand = self._base_plan
+                    if not _bfs.bits_batch_ok(self.a, cand):
+                        cand = _bfs.plan_bfs(self.a, route=True)
+                    if _bfs.bits_batch_ok(self.a, cand):
+                        self._bits_plan = cand
+                        if self._base_plan is None:
+                            self._base_plan = cand
+                if mode == "on" and self._bits_plan is None:
+                    raise ValueError(
+                        "bfs_bits='on' but the matrix is not eligible "
+                        "for the packed-bit batch path (needs a 1x1 "
+                        "grid and a pattern-symmetric matrix; see "
+                        "models.bfs.bits_batch_ok)")
+                if self._base_plan is None:
+                    self._base_plan = _bfs.plan_bfs(self.a)
+                self._plans_resolved = True
+            return self._base_plan, self._bits_plan
+
     def _bfs_plan(self, bucket: int):
+        """(effective bucket, executor) for a BFS batch. On the bits
+        path the bucket aligns UP to a multiple of the 32-root lane
+        width — the whole lane word travels regardless, so the extra
+        slots are free — and the cache key carries the lane width."""
+        base, bits = self._bfs_structure()
+        if bits is not None:
+            eb = -(-bucket // _LANE_W) * _LANE_W
+            key = PlanKey("bfs", "bits", eb, self._mesh, _LANE_W)
+            return eb, self.plans.get_or_build(
+                key, lambda: lambda roots, ml: _bfs.bfs_batch_bits(
+                    self.a, roots, ml, plan=bits))
         key = PlanKey("bfs", "select2nd_max_i32", bucket, self._mesh)
-        return self.plans.get_or_build(
+        return bucket, self.plans.get_or_build(
             key, lambda: lambda roots, ml: _bfs.bfs_batch(
-                self.a, roots, ml))
+                self.a, roots, ml, plan=base))
 
     def _run_bfs(self, batch: Batch) -> None:
         reqs = batch.requests
         roots = np.array([r.payload for r in reqs], np.int32)
-        roots_p = self._pad(roots, batch.bucket)
         # deadline -> level budget: enough levels to fit the tightest
         # remaining deadline at the current EWMA per-level estimate
         # (floor 1: always make progress). 0 = unbounded.
@@ -312,13 +410,18 @@ class GraphService:
             budget = max(1, int(min(rem) /
                                 max(self._bfs_level_est, 1e-9)))
             ml = budget if ml <= 0 else min(ml, budget)
-        fn = self._bfs_plan(batch.bucket)
+        bucket, fn = self._bfs_plan(batch.bucket)
+        roots_p = self._pad(roots, bucket)
         t0 = time.monotonic()
         mv, lvl, done = fn(jnp.asarray(roots_p), jnp.int32(ml))
         parents = mv.to_global()              # blocks on readback
         wall = time.monotonic() - t0
         self._count_dispatch("bfs")
-        levels = int(lvl)
+        lvl = np.asarray(lvl)
+        # bits path: per-lane level counts; dense path: one scalar wave
+        # count. The EWMA tracks the wave (max), each result reports
+        # its own lane.
+        levels = int(lvl.max()) if lvl.ndim else int(lvl)
         done = np.asarray(done)
         if levels > 0:
             self._bfs_level_est = (0.7 * self._bfs_level_est
@@ -328,8 +431,9 @@ class GraphService:
             if not complete:
                 with self._stats_lock:
                     self.stats["partials"] += 1
-            self._finish(r, BfsResult(parents[:, k], levels, complete,
-                                      int(roots[k])))
+            self._finish(r, BfsResult(
+                parents[:, k], int(lvl[k]) if lvl.ndim else levels,
+                complete, int(roots[k])))
 
     def _labels_device(self):
         """Component labels, computed once for the service lifetime
@@ -341,6 +445,13 @@ class GraphService:
                 self._count_dispatch("cc_labels")
             return self._cc_labels
 
+    def _update_cost(self, kind: str, wall: float) -> None:
+        """EWMA per-dispatch wall estimate feeding _shed_predicted
+        (same 0.7/0.3 blend as the BFS level estimate)."""
+        old = self._cost_est.get(kind)
+        self._cost_est[kind] = (wall if old is None
+                                else 0.7 * old + 0.3 * wall)
+
     def _run_cc(self, batch: Batch) -> None:
         reqs = batch.requests
         labels = self._labels_device()
@@ -349,7 +460,9 @@ class GraphService:
         key = PlanKey("cc", "-", batch.bucket, self._mesh)
         fn = self.plans.get_or_build(
             key, lambda: jax.jit(lambda lab, ix: lab[ix]))
+        t0 = time.monotonic()
         out = np.asarray(fn(labels, jnp.asarray(verts_p)))
+        self._update_cost("cc", time.monotonic() - t0)
         self._count_dispatch("cc")
         for k, r in enumerate(reqs):
             self._finish(r, int(out[k]))
@@ -382,7 +495,9 @@ class GraphService:
         xs = np.stack([r.payload[0] for r in reqs])    # (w, glen)
         xs = self._pad(xs, batch.bucket).T             # (glen, bucket)
         fn = self._spmv_plan(sr, batch.bucket)
+        t0 = time.monotonic()
         y = fn(xs)                                     # (nrows, bucket)
+        self._update_cost(f"spmv:{sr.name}", time.monotonic() - t0)
         self._count_dispatch(f"spmv:{sr.name}")
         for k, r in enumerate(reqs):
             self._finish(r, y[:, k])
@@ -402,8 +517,9 @@ class GraphService:
         for kind in kinds:
             for b in buckets:
                 if kind == "bfs":
-                    mv, lvl, done = self._bfs_plan(b)(
-                        jnp.zeros((b,), jnp.int32), jnp.int32(1))
+                    eb, fn = self._bfs_plan(b)
+                    mv, lvl, done = fn(
+                        jnp.zeros((eb,), jnp.int32), jnp.int32(1))
                     jax.block_until_ready(mv.data)
                     self._count_dispatch("bfs", warmup=True)
                 elif kind == "cc":

@@ -33,6 +33,8 @@ class PlanKey(NamedTuple):
     semiring: str      # semiring name, or "-" when kind implies it
     bucket: int        # padded batch width
     mesh: tuple        # (pr, pc) grid shape
+    lanes: int = 0     # packed-bit lane width (bfs bits path: 32 roots
+    #                    per uint32 word; 0 = dense/unpacked executable)
 
 
 @dataclasses.dataclass

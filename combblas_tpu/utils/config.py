@@ -74,6 +74,22 @@ class ServeConfig:
     bfs_level_est_s: float = 2e-3   # EWMA seed for per-level wall time
     bfs_max_levels: int = 0         # 0 = unbounded (deadline may cap)
     drain_poll_s: float = 0.05      # shutdown drain poll interval
+    # BFS batch path: "auto" uses the packed-bit bitplane kernel
+    # (models.bfs.bfs_batch_bits) when the matrix is eligible
+    # (single-tile, routed, pattern-symmetric), "on" requires it
+    # (ValueError when ineligible), "off" forces the dense-column
+    # bfs_batch. COMBBLAS_TPU_SERVE_BITS=0 in the environment
+    # overrides to "off" without a config change.
+    bfs_bits: str = "auto"
+    # shed-before-dispatch: reject cc/spmv requests whose remaining
+    # deadline is below the kind's EWMA dispatch-cost estimate instead
+    # of running a doomed dispatch (BFS keeps its finer level-budget
+    # degradation)
+    predictive_shed: bool = True
+    # serve.latency_s histogram percentiles: True switches the metric
+    # to streaming P² sketches (full-run p50/p90/p99 on unbounded
+    # soaks); False keeps the sliding 2048-sample reservoir
+    latency_sketch: bool = False
 
 
 def parse_cli(cls: Type[T], argv: Optional[list] = None,

@@ -36,8 +36,9 @@ def test_cc_driver_lacc(capsys):
     assert j["algo"] == "lacc" and j["components"] >= 1
 
 
-def test_mcl_driver(tmp_path, capsys):
-    from combblas_tpu.apps import mcl as app
+@pytest.mark.slow   # ~85s of MCL-pipeline compiles at ANY scale; the
+def test_mcl_driver(tmp_path, capsys):          # algorithm itself is
+    from combblas_tpu.apps import mcl as app    # tier-1 via test_mcl.py
     out = tmp_path / "clusters.txt"
     app.main(["--scale", "7", "--edgefactor", "4", "--o", str(out)])
     j = _capture(capsys)

@@ -257,6 +257,126 @@ class TestStepperCrossCheck:
         assert all(ec >= 1024 for ec in ecs)
 
 
+@pytest.fixture(scope="module")
+def bits_graph():
+    """1x1-grid symmetric graph with isolated vertices and a routed
+    plan eligible for the packed-bit batch path."""
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    rng = np.random.default_rng(7)
+    n, m = 192, 420
+    r = rng.integers(0, n - 8, m)            # leave the top 8 isolated
+    c = rng.integers(0, n - 8, m)
+    rows = np.concatenate([r, c]).astype(np.int32)
+    cols = np.concatenate([c, r]).astype(np.int32)
+    a = DM.from_global_coo(S.LOR, grid, rows, cols,
+                           jnp.ones(len(rows), jnp.bool_), n, n)
+    plan = B.plan_bfs(a, route=True)
+    assert B.bits_batch_ok(a, plan)
+    return a, plan, n, rows, cols
+
+
+def _chase_levels(parents, root, n):
+    level = np.full(n, -1, np.int64)
+    level[root] = 0
+    children = {}
+    for v in np.nonzero(parents >= 0)[0]:
+        if v != root:
+            children.setdefault(int(parents[v]), []).append(v)
+    frontier = [root]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in children.get(u, ()):
+                level[v] = level[u] + 1
+                nxt.append(v)
+        frontier = nxt
+    return level
+
+
+class TestBfsBatchBits:
+    """Bitplane multi-root BFS: structural parity with per-root bfs()
+    (the bitplane tree's parent CHOICES may legally differ — max-id
+    over a differently ordered candidate set — so levels and visited
+    sets are the bit-exact contract, validate_bfs the tree contract)."""
+
+    def test_parity_duplicates_and_isolated(self, bits_graph):
+        a, plan, n, rows, cols = bits_graph
+        roots = [0, 5, 5, 190, 3, 77, 12, 1]   # dups + isolated 190
+        mv, lvl, done = B.bfs_batch_bits(a, np.array(roots, np.int32),
+                                         plan=plan)
+        pg = np.asarray(mv.to_global())
+        lvl, done = np.asarray(lvl), np.asarray(done)
+        assert lvl.shape == done.shape == (len(roots),)
+        assert done.all()
+        for k, root in enumerate(roots):
+            ref = np.asarray(B.bfs(a, root, plan).to_global())
+            np.testing.assert_array_equal(pg[:, k] >= 0, ref >= 0,
+                                          err_msg=f"lane {k}")
+            B.validate_bfs(rows, cols, n, root, pg[:, k])
+            np.testing.assert_array_equal(
+                _chase_levels(pg[:, k], root, n),
+                _chase_levels(ref, root, n), err_msg=f"lane {k}")
+            assert lvl[k] == _chase_levels(ref, root, n).max()
+        # isolated root: just itself, zero levels
+        assert int(lvl[3]) == 0
+        assert np.sum(pg[:, 3] >= 0) == 1 and pg[190, 3] == 190
+
+    def test_batch_smaller_than_lane_word(self, bits_graph):
+        a, plan, n, rows, cols = bits_graph
+        roots = [7, 7, 42, 0, 99]              # W=5 < 32
+        mv, lvl, done = B.bfs_batch_bits(a, np.array(roots, np.int32),
+                                         plan=plan)
+        pg = np.asarray(mv.to_global())
+        assert np.asarray(done).all()
+        for k, root in enumerate(roots):
+            B.validate_bfs(rows, cols, n, root, pg[:, k])
+
+    def test_root_out_of_range_rejected(self, bits_graph):
+        a, plan, n, _, _ = bits_graph
+        with pytest.raises(ValueError, match="outside"):
+            B.bfs_batch_bits(a, np.array([0, n], np.int32), plan=plan)
+        with pytest.raises(ValueError, match="outside"):
+            B.bfs_batch_bits(a, np.array([-1], np.int32), plan=plan)
+
+    def test_per_lane_max_levels_partial(self, bits_graph):
+        """max_levels truncates each lane independently: reached sets
+        match the dense bfs_batch prefix, done is per-lane (the
+        isolated root IS done after 0 levels)."""
+        a, plan, n, _, _ = bits_graph
+        roots = np.array([0, 190, 42], np.int32)
+        mv, lvl, done = B.bfs_batch_bits(a, roots, max_levels=1,
+                                         plan=plan)
+        pg = np.asarray(mv.to_global())
+        dmv, _, ddone = B.bfs_batch(a, roots, max_levels=1)
+        dg = np.asarray(dmv.to_global())
+        np.testing.assert_array_equal(pg >= 0, dg >= 0)
+        done = np.asarray(done)
+        assert not done[0] and not done[2]     # more frontier waiting
+        assert done[1]                         # isolated: already done
+        np.testing.assert_array_equal(np.asarray(lvl), [1, 0, 1])
+
+    def test_mesh_fallback_matches_dense(self, grid22):
+        """On a multi-tile grid the wrapper must fall back to the
+        dense bfs_batch and broadcast its scalar level count to the
+        per-lane shape."""
+        from combblas_tpu.ops import generate
+        n = 1 << 9
+        r, c = generate.rmat_edges(jax.random.key(3), 9, 6)
+        r, c = generate.symmetrize(r, c)
+        a = DM.from_global_coo(S.LOR, grid22, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        roots = np.array([0, 5, 5, 17], np.int32)
+        mv, lvl, done = B.bfs_batch_bits(a, roots)
+        dmv, dlvl, ddone = B.bfs_batch(a, roots)
+        np.testing.assert_array_equal(np.asarray(mv.to_global()),
+                                      np.asarray(dmv.to_global()))
+        lvl = np.asarray(lvl)
+        assert lvl.shape == (4,)
+        np.testing.assert_array_equal(lvl, np.full(4, int(dlvl)))
+        np.testing.assert_array_equal(np.asarray(done),
+                                      np.asarray(ddone))
+
+
 def test_plan_route_more_rows_than_slots():
     """A single-tile matrix with more rows than padded edge slots must
     still plan (the start-compact parent-extract permutation cannot

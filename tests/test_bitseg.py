@@ -12,6 +12,15 @@ from combblas_tpu.ops import route as R
 pytestmark = pytest.mark.quick  # core-correctness fast subset
 
 
+@pytest.fixture(autouse=True)
+def _small_blocks(monkeypatch):
+    # interpret-mode Pallas walks every block in Python: shrink the
+    # streamed-block row count so multi-block carry coverage stays
+    # cheap (the carry/stitch logic is independent of block size;
+    # tests below size their inputs from BS._BLR at call time)
+    monkeypatch.setattr(BS, "_BLR", 64)
+
+
 def _segments(starts_bool):
     seg = np.cumsum(starts_bool.astype(np.int64)) - 1
     return seg
@@ -30,11 +39,11 @@ def test_fill_pallas_multiblock_carry(rng):
     n = npad
     starts = np.zeros(n, bool)
     # long runs, several straddling the block boundary
-    for pos in range(0, n, 997_001):
+    for pos in range(0, n, n // 4 + 7):
         starts[pos] = True
     starts[0] = True
     x = np.zeros(n, bool)
-    x[::1_003_003] = True                    # sparse set bits
+    x[::n // 3 + 11] = True                  # sparse set bits
     seg = np.cumsum(starts) - 1
     expect = np.zeros(n, bool)
     for sid in np.unique(seg[np.nonzero(x)[0]]):
@@ -50,7 +59,7 @@ def test_fill_pallas_pad_path(rng):
     the pad rows must stay inert (self-segmenting starts, zero data)
     and not corrupt the backward carry into the last real block."""
     from combblas_tpu.ops import bitseg as BS2
-    r = 640                                   # 1 full block + 128 rows
+    r = BS2._BLR + BS2._BLR // 4              # 1 full block + pad rows
     npad = r * 128 * 32
     starts = np.zeros(npad, bool)
     starts[0] = True
@@ -114,11 +123,12 @@ def test_seg_or_scan_matches_numpy(rng, n, p):
     np.testing.assert_array_equal(gote.astype(bool), expect_ends)
 
 
-@pytest.mark.parametrize("nrows", [
-    128,                  # one block, beyond-lane strides
-    BS._BLR * 2 + 128,    # 3 blocks + pad rows: cross-block carry,
+@pytest.mark.parametrize("case", [
+    "single",             # one block, beyond-lane strides
+    "multi",              # 3 blocks + pad rows: cross-block carry,
 ])                        # flag accumulation, and the pad branch
-def test_fill_bfs_fused_tail_matches_composition(rng, nrows):
+def test_fill_bfs_fused_tail_matches_composition(rng, case):
+    nrows = BS._BLR if case == "single" else BS._BLR * 2 + BS._BLR // 2
     """The fused BFS level tail (seg_or_fill_bfs_pallas: backward fill
     + frontier update + parent-candidate accumulate + nonempty flag)
     is bit-identical to the unfused op composition it replaces."""
@@ -155,6 +165,77 @@ def test_fill_bfs_fused_tail_matches_composition(rng, nrows):
     assert int(np.asarray(flag0)[0, 0]) == 0
 
 
+@pytest.mark.parametrize("w", [1, 3, 32])
+def test_multi_lane_matches_single_lane(rng, w):
+    """seg_or_{scan,fill}_bits_multi on an (nwords, W) matrix must be
+    the per-lane application of the single-lane primitives (shared
+    segment starts, independent data per lane)."""
+    npad = 1 << 12
+    starts = rng.random(npad) < 0.1
+    starts[0] = True
+    sw = _pack(starts, npad)
+    lanes = [rng.random(npad) < 0.05 for _ in range(w)]
+    x = jnp.stack([_pack(b, npad) for b in lanes], axis=1)
+    scan = BS.seg_or_scan_bits_multi(x, sw)
+    fill = BS.seg_or_fill_bits_multi(x, sw)
+    for k in range(w):
+        np.testing.assert_array_equal(
+            np.asarray(scan[:, k]),
+            np.asarray(BS.seg_or_scan_bits(_pack(lanes[k], npad), sw)),
+            err_msg=f"scan lane {k}")
+        np.testing.assert_array_equal(
+            np.asarray(fill[:, k]),
+            np.asarray(BS.seg_or_fill_bits(_pack(lanes[k], npad), sw)),
+            err_msg=f"fill lane {k}")
+
+
+def test_multi_fill_pallas_cross_block_carry(rng, monkeypatch):
+    """The multi-lane Pallas fill streams blocks per lane with an SMEM
+    carry; segments straddling the block boundary must stitch in every
+    lane, and lanes must not bleed into each other. _BLR is shrunk so
+    interpret mode walks 4 blocks cheaply — carry logic is identical
+    at any block size."""
+    monkeypatch.setattr(BS, "_BLR", 8)
+    npad = BS._BLR * 128 * 32 * 4            # exactly 4 blocks
+    starts = np.zeros(npad, bool)
+    starts[0] = True
+    for pos in range(0, npad, 7_001):        # block-straddling runs
+        starts[pos] = True
+    sw = _pack(starts, npad)
+    lanes = []
+    for k in range(2):
+        b = np.zeros(npad, bool)
+        b[k::10_007 + k] = True              # distinct sparse patterns
+        lanes.append(b)
+    x = jnp.stack([_pack(b, npad) for b in lanes], axis=1)
+    got = BS.seg_or_fill_multi_pallas(x, sw, interpret=True)
+    ref = BS.seg_or_fill_bits_multi(x, sw)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_apply_route_multi_matches_per_lane(rng):
+    """apply_route_multi (and its _best dispatcher) on an (nwords, W)
+    matrix equals apply_route applied lane by lane — compact and
+    non-compact Beneš plans, odd W (pair-path duplicate lane)."""
+    from combblas_tpu.ops import route as RT
+    for n, w in ((1 << 8, 3), (1 << 11, 3)):
+        perm = rng.permutation(n).astype(np.int32)
+        rp = RT.plan_route(perm)
+        lanes = [rng.integers(0, 2, n).astype(np.int8) for _ in range(w)]
+        words = jnp.stack(
+            [RT.pack_bits(jnp.asarray(b), rp.npad) for b in lanes],
+            axis=1)
+        for fn in (RT.apply_route_multi, RT.apply_route_multi_best):
+            got = fn(rp, words)
+            for k in range(w):
+                np.testing.assert_array_equal(
+                    np.asarray(got[:, k]),
+                    np.asarray(RT.apply_route(
+                        rp, RT.pack_bits(jnp.asarray(lanes[k]),
+                                         rp.npad))),
+                    err_msg=f"{fn.__name__} n={n} lane {k}")
+
+
 def test_route_and_mask_fusion(rng):
     """apply_route_pallas(and_mask=...) equals route-then-AND."""
     n = 1 << 14
@@ -175,7 +256,7 @@ def test_parent_planes_matches_numpy_model(rng):
     pcand bit (rows are (row,col)-sorted, so highest bit = max col);
     the last plane is 'row has a candidate'. Multi-block (cross-block
     carries) and single-block cases."""
-    for nrows_w in (16, BS._BLR * 2 + 128):
+    for nrows_w in (16, BS._BLR * 2 + BS._BLR // 2):
         npad = nrows_w * 128 * 32
         n = npad
         starts = np.zeros(n, bool)

@@ -96,6 +96,41 @@ def test_fused_sorts_strictly_narrower_than_2key(rng, monkeypatch):
     assert fused == 4 and legacy == 6   # (key+payload) vs (row+col+payload)
 
 
+def test_bfs_bits_batch_core_structure(rng):
+    """The bitplane multi-root BFS core lowers to ONE fused while loop
+    (the whole wave — route, fill, frontier update — per level, all
+    lanes together), no sorts, no i64 tensors; and the op structure is
+    identical at W=8 and W=16 (lanes ride array shapes — no per-root
+    unrolling)."""
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.parallel import distmat as DM
+    from combblas_tpu.parallel.grid import ProcGrid
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    n = 256
+    r = rng.integers(0, n, 600).astype(np.int32)
+    c = rng.integers(0, n, 600).astype(np.int32)
+    rows = np.concatenate([r, c])
+    cols = np.concatenate([c, r])
+    a = DM.from_global_coo(S.LOR, grid, jnp.asarray(rows),
+                           jnp.asarray(cols),
+                           jnp.ones(len(rows), jnp.bool_), n, n)
+    plan = B.plan_bfs(a, route=True)
+    assert B.bits_batch_ok(a, plan)
+    ml = jnp.int32(1 << 30)
+    txts = {}
+    for w in (8, 16):
+        txts[w] = _lower_text(B._bfs_batch_bits_core, a, plan,
+                              jnp.zeros((w,), jnp.int32), ml)
+        # while is pretty-printed unquoted, unlike sort/gather
+        assert len(re.findall(r"stablehlo\.while", txts[w])) == 1, \
+            f"W={w}"
+        assert _count(txts[w], "sort") == 0, f"W={w}"
+        assert _no_i64_tensors(txts[w]), f"W={w}"
+    ops = {w: len(re.findall(r"stablehlo\.", t))
+           for w, t in txts.items()}
+    assert ops[8] == ops[16], ops
+
+
 def test_colwindow_window_codec_stays_i32(rng, monkeypatch):
     # a tile shape whose FULL key space overflows 2^31: without the
     # window-relative codec the window kernel would fall back to 2-key

@@ -426,6 +426,56 @@ def test_jsonl_metrics_line(obs_on, tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# P² streaming quantile sketch
+# ---------------------------------------------------------------------------
+
+def test_p2_exact_below_five_samples():
+    est = metrics.P2Quantile(0.5)
+    assert est.value() is None
+    for x, want in [(3.0, 3.0), (1.0, 1.0), (2.0, 2.0),
+                    (9.0, 2.0), (0.0, 2.0)]:
+        est.observe(x)
+        assert est.value() == want          # nearest-rank on raw samples
+
+
+def test_p2_tracks_numpy_percentiles():
+    import numpy as np
+    rng = np.random.default_rng(3)
+    for data in (rng.uniform(0, 1, 10_000),
+                 rng.lognormal(0, 1, 10_000)):
+        for p in (0.5, 0.9, 0.99):
+            est = metrics.P2Quantile(p)
+            for x in data:
+                est.observe(float(x))
+            ref = float(np.percentile(data, p * 100))
+            tol = 0.05 if p < 0.99 else 0.10   # far tail: fewer samples
+            assert abs(est.value() - ref) <= tol * abs(ref), \
+                (p, est.value(), ref)
+
+
+def test_histogram_sketch_survives_reservoir_wrap(obs_on):
+    """Past the reservoir cap the sliding window forgets the early
+    regime; the P² sketch keeps summarizing the FULL stream. Toggling
+    the sketch off falls back to reservoir percentiles, and snapshots
+    stay JSON-serializable either way."""
+    h = metrics.Histogram("t.sk", bounds=(10**9,))
+    h.use_sketch(True)
+    for _ in range(metrics._RESERVOIR):
+        h.observe(1.0)
+    for _ in range(metrics._RESERVOIR):     # overwrites the window
+        h.observe(100.0)
+    s = h.series()
+    assert s["count"] == 2 * metrics._RESERVOIR
+    # full-run p50 straddles the two regimes; the window-only value
+    # is pinned at 100
+    assert s["p50"] < 100.0
+    json.dumps(h.snapshot())
+    h.use_sketch(False)
+    assert h.series()["p50"] == 100.0       # reservoir view restored
+    json.dumps(h.snapshot())
+
+
+# ---------------------------------------------------------------------------
 # the utils.timing compat shim
 # ---------------------------------------------------------------------------
 

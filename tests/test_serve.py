@@ -347,6 +347,154 @@ class TestGraphService:
 
 
 # ---------------------------------------------------------------------------
+# the packed-bit batch path (1x1 grid) + predictive shed + sketch knob
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def graph11():
+    """Same topology as `graph` but on a 1x1 grid with a boolean
+    pattern — the eligibility domain of the packed-bit batch path."""
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    rng = np.random.default_rng(7)
+    n, m = 192, 420
+    r = rng.integers(0, n - 8, m)
+    c = rng.integers(0, n - 8, m)
+    rows = np.concatenate([r, c]).astype(np.int32)
+    cols = np.concatenate([c, r]).astype(np.int32)
+    a = DM.from_global_coo(S.LOR, grid, rows, cols,
+                           jnp.ones(len(rows), jnp.bool_), n, n)
+    return a, n
+
+
+def _visited(parents):
+    return np.asarray(parents) >= 0
+
+
+class TestBitsServe:
+    def test_bits_path_engages_lane_aligned(self, graph11):
+        """On a 1x1 grid the default (auto) config routes BFS batches
+        through bfs_batch_bits: the plan key carries the lane width,
+        the bucket aligns up to 32, and results match per-root bfs()
+        on visited sets and levels (bitplane parent choices may
+        differ)."""
+        a, n = graph11
+        cfg = ServeConfig(buckets=(1, 2, 4), batch_wait_s=0.0)
+        svc = serve.GraphService(a, cfg, autostart=False)
+        roots = [0, 5, 5, 190]                  # dups + isolated
+        handles = [svc.submit_bfs(r) for r in roots]
+        svc.start()
+        res = [h.result(timeout=600) for h in handles]
+        svc.stop()
+        assert svc.stats["dispatches"] == 1     # one lane-word dispatch
+        bfs_keys = [k for k in svc.plans.keys() if k.kind == "bfs"]
+        assert [(k.semiring, k.bucket, k.lanes) for k in bfs_keys] == \
+            [("bits", 32, 32)]
+        plan = B.plan_bfs(a, route=True)
+        for root, out in zip(roots, res):
+            assert out.complete and out.root == root
+            ref = np.asarray(B.bfs(a, root, plan).to_global())
+            np.testing.assert_array_equal(_visited(out.parents),
+                                          _visited(ref))
+        assert res[3].levels == 0               # isolated: only itself
+        assert res[0].levels > 0
+
+    def test_env_opt_out_forces_dense(self, graph11, monkeypatch):
+        a, n = graph11
+        monkeypatch.setenv("COMBBLAS_TPU_SERVE_BITS", "0")
+        svc = serve.GraphService(a, ServeConfig(buckets=(1,)),
+                                 autostart=False)
+        h = svc.submit_bfs(3)
+        svc.start()
+        out = h.result(timeout=600)
+        svc.stop()
+        assert out.complete
+        np.testing.assert_array_equal(out.parents,
+                                      B.bfs(a, 3).to_global())
+        assert [(k.semiring, k.bucket, k.lanes)
+                for k in svc.plans.keys()] == \
+            [("select2nd_max_i32", 1, 0)]
+
+    def test_bits_on_ineligible_mesh_raises(self, graph):
+        a, _ = graph                            # 2x4 grid: ineligible
+        svc = serve.GraphService(a, ServeConfig(
+            buckets=(1,), bfs_bits="on"), autostart=False)
+        h = svc.submit_bfs(0)
+        svc.start()
+        with pytest.raises(ValueError, match="not eligible"):
+            h.result(timeout=600)
+        svc.stop()
+
+    def test_bits_deadline_partial_per_lane(self, graph11):
+        """A one-level budget on the bits path degrades to a per-lane
+        partial: the reached set equals the dense one-level prefix and
+        the handle resolves (no error)."""
+        a, n = graph11
+        cfg = ServeConfig(buckets=(1,), bfs_level_est_s=1000.0)
+        svc = serve.GraphService(a, cfg, autostart=False)
+        h = svc.submit_bfs(0, deadline_s=5.0)
+        svc.start()
+        out = h.result(timeout=600)
+        svc.stop()
+        assert not out.complete and out.levels == 1
+        assert svc.stats["partials"] == 1
+        mv, _, _ = B.bfs_batch(a, np.array([0], np.int32),
+                               max_levels=1)
+        np.testing.assert_array_equal(
+            _visited(out.parents), _visited(mv.to_global()[:, 0]))
+
+
+class TestPredictiveShed:
+    def test_sheds_before_dispatch(self, graph):
+        """A cc request whose remaining deadline is below the learned
+        EWMA dispatch cost fails with the typed error BEFORE any
+        device work — zero dispatches, shed counted."""
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        svc._cost_est["cc"] = 1000.0            # learned: way too slow
+        h = svc.submit_cc(0, deadline_s=5.0)
+        svc.start()
+        with pytest.raises(serve.DeadlineExceededError,
+                           match="predicted"):
+            h.result(timeout=600)
+        svc.stop()
+        assert svc.stats["shed"] == 1
+        assert svc.stats["dispatches"] == 0
+
+    def test_opt_out_dispatches_anyway(self, graph):
+        a, _ = graph
+        cfg = ServeConfig(buckets=(1, 2, 4), batch_wait_s=0.0,
+                          predictive_shed=False)
+        svc = serve.GraphService(a, cfg, autostart=False)
+        svc._cost_est["cc"] = 1000.0
+        h = svc.submit_cc(0, deadline_s=30.0)
+        svc.start()
+        assert h.result(timeout=600) is not None
+        svc.stop()
+        assert svc.stats["dispatches"] >= 1
+
+    def test_cost_estimate_learned_from_dispatch(self, graph):
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        h = svc.submit_cc(0)
+        svc.start()
+        h.result(timeout=600)
+        svc.stop()
+        assert svc._cost_est.get("cc", 0) > 0
+
+    def test_latency_sketch_config_toggles_metric(self, graph):
+        from combblas_tpu.serve import engine as E
+        a, _ = graph
+        svc = serve.GraphService(a, ServeConfig(
+            buckets=(1,), latency_sketch=True), autostart=False)
+        try:
+            assert E._latency._sketch is True
+        finally:
+            E._latency.use_sketch(False)
+            svc.start()
+            svc.stop()
+
+
+# ---------------------------------------------------------------------------
 # the acceptance bound: batched dispatches vs sequential per-query
 # ---------------------------------------------------------------------------
 
@@ -361,8 +509,11 @@ def _mixed_workload(svc, a, bfs_plan, labels, nquery, rng, seed_roots):
     handles = [(k, int(v), svc.submit_bfs(int(v)) if k == "bfs"
                 else svc.submit_cc(int(v)))
                for k, v in zip(kinds, picks)]
-    svc.start()
+    # reference BEFORE start: the worker thread runs multi-device
+    # collectives, and a concurrent jitted computation on the main
+    # thread can deadlock with them on the emulated CPU mesh
     ref = seq_bfs(a, bfs_plan, [v for k, v, _ in handles if k == "bfs"])
+    svc.start()
     for k, v, h in handles:
         out = h.result(timeout=600)
         if k == "bfs":
@@ -403,6 +554,32 @@ def test_soak_512_query_acceptance(graph, bfs_plan, rng):
     served, sequential = _mixed_workload(svc, a, bfs_plan, labels, 512,
                                          rng, roots)
     assert sequential >= 8 * served, (served, sequential)
+
+
+@pytest.mark.slow
+def test_soak_bits_256_query(graph11, rng):
+    """256 BFS queries through the bits service: every dispatch is
+    lane-aligned, every result structurally verified, and the
+    dispatch amortization holds at >=8x."""
+    a, n = graph11
+    cfg = ServeConfig(buckets=(1, 2, 4, 8, 16, 32), batch_wait_s=0.0)
+    svc = serve.GraphService(a, cfg, autostart=False)
+    pool = np.array([0, 5, 17, 42, 99, 150, 1, 190], np.int64)
+    picks = rng.choice(pool, size=256)
+    handles = [(int(v), svc.submit_bfs(int(v))) for v in picks]
+    svc.start()
+    plan = B.plan_bfs(a, route=True)
+    ref = {v: np.asarray(B.bfs(a, v, plan).to_global())
+           for v in {int(v) for v in picks}}
+    for v, h in handles:
+        out = h.result(timeout=600)
+        assert out.complete
+        np.testing.assert_array_equal(_visited(out.parents),
+                                      _visited(ref[v]))
+    svc.stop()
+    assert all(k.lanes == 32 for k in svc.plans.keys()
+               if k.kind == "bfs")
+    assert 256 >= 8 * svc.stats["dispatches"]
 
 
 @pytest.mark.slow
