@@ -1,0 +1,61 @@
+"""`combblas_tpu.analysis` — static-analysis gate for the repo's
+structural invariants.
+
+Three passes, one verdict (see `scripts/analyze.py --gate` and the
+README "Static analysis" section):
+
+1. **Budget engine** (`budget.run_budgets`) — lowers registered
+   kernel entry points (`entries.py`) and checks the jaxpr + StableHLO
+   against declarative JSON budgets in `analysis/budgets/`: exact sort
+   counts and sorted-operand arity, gather/scatter/while ceilings,
+   forbidden dtypes (i64) and ops (host callbacks), lane-width
+   invariance for the packed-bit path.
+2. **Retrace-drift detector** (`retrace.run_retrace`) — replays the
+   serve layer's argument-prep recipes over the bucket ladder and
+   flags avoidable recompiles: weak-type drift, Python-scalar
+   leakage, plan-cache groups whose jit cache keys diverge, compile
+   counts that drift from `budgets/retrace_serve.json`.
+3. **Lock-order lint** (`lockorder.run_lockorder`) — AST pass over
+   the package building the lock-acquisition graph: ordering cycles,
+   blocking jit dispatch under a held lock (the PR-4 deadlock shape),
+   bare `acquire()` without try/finally.
+
+All passes are trace/AST only — nothing here compiles or executes
+device code — and every finding carries `file:line`, a rule id, and a
+suppression syntax (`# analysis: allow(<rule>)` in source, `"allow"`
+lists in the JSON budgets).
+"""
+
+from __future__ import annotations
+
+from combblas_tpu.analysis.core import (  # noqa: F401
+    ALL_RULES, Finding, format_report, is_suppressed, scan_suppressions,
+)
+
+
+def run_budgets(**kw):
+    from combblas_tpu.analysis import budget
+    return budget.run_budgets(**kw)
+
+
+def run_retrace(**kw):
+    from combblas_tpu.analysis import retrace
+    return retrace.run_retrace(**kw)
+
+
+def run_lockorder(**kw):
+    from combblas_tpu.analysis import lockorder
+    return lockorder.run_lockorder(**kw)
+
+
+def run_all(passes=("budgets", "retrace", "locks")) -> list[Finding]:
+    """Run the selected passes; returns all unsuppressed findings
+    (empty = gate passes)."""
+    out: list[Finding] = []
+    if "budgets" in passes:
+        out += run_budgets()
+    if "retrace" in passes:
+        out += run_retrace()
+    if "locks" in passes:
+        out += run_lockorder()
+    return out

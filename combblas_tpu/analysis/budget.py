@@ -1,0 +1,228 @@
+"""Pass 1 — declarative jaxpr/HLO budget engine.
+
+Budgets are JSON files under `combblas_tpu/analysis/budgets/`, each
+holding a list of kernel budgets::
+
+    {"kernels": [{
+        "entry": "esc.spgemm",            # entries.py registry name
+        "env": {"COMBBLAS_TPU_FUSED_KEY": null},   # null = must be unset
+        "sorts": {"count": 2, "operands_per_sort": 2,
+                  "operands_total": 4},   # all EXACT
+        "ceilings": {"gather": 20, "scatter": 10,
+                     "dynamic_slice": 64, "while": 4},   # maxima
+        "forbid_dtypes": ["i64"],
+        "forbid_ops": ["callback"],       # substring match on jaxpr
+                                          # primitives + custom_call targets
+        "lane_invariance": true,          # variants must lower to the
+                                          # same op histogram
+        "allow": []                       # waived rule ids
+    }]}
+
+Sort budgets are EXACT in both directions: dropping below a pin means
+the committed number is stale and must be re-measured, not silently
+celebrated. Ceilings are maxima — dropping below them is improvement.
+The numbers here are the single source of truth; tests
+(`tests/test_hlo_passes.py`, `tests/test_analysis.py`) are thin shims
+over this module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Optional
+
+from combblas_tpu.analysis import core, entries, hlo
+from combblas_tpu.analysis.core import Finding
+
+BUDGET_DIR = pathlib.Path(__file__).parent / "budgets"
+
+#: ops whose ceilings a budget may pin (budget key -> stablehlo op)
+_CEILING_OPS = ("sort", "gather", "scatter", "dynamic_slice",
+                "dynamic_update_slice", "while", "reduce", "iota",
+                "custom_call", "all_reduce", "all_to_all")
+
+
+def load_budget_file(path) -> tuple[list[dict], str]:
+    text = pathlib.Path(path).read_text()
+    data = json.loads(text)
+    kernels = data.get("kernels", [])
+    for k in kernels:
+        if "entry" not in k:
+            raise ValueError(f"{path}: kernel budget without 'entry'")
+    return kernels, text
+
+
+def _line_of(text: str, anchor: str, key: str) -> int:
+    """Line of ``key`` inside the budget block that contains
+    ``anchor`` (the entry name) — findings point at the violated
+    number, not just the file."""
+    lines = text.splitlines()
+    start = 0
+    for i, ln in enumerate(lines):
+        if anchor in ln:
+            start = i
+            break
+    for i in range(start, len(lines)):
+        if f'"{key}"' in lines[i]:
+            return i + 1
+    return start + 1
+
+
+class _Env:
+    """Apply a budget's env overrides for the duration of the trace
+    (null = ensure unset), clearing jit caches when anything changes —
+    env-dependent branches (COMBBLAS_TPU_FUSED_KEY) are read at trace
+    time."""
+
+    def __init__(self, env: Optional[dict]):
+        self.env = env or {}
+        self._saved: dict = {}
+
+    def __enter__(self):
+        if not self.env:
+            return self
+        import jax
+        for k, v in self.env.items():
+            self._saved[k] = os.environ.get(k)
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        jax.clear_caches()
+        return self
+
+    def __exit__(self, *exc):
+        if not self.env:
+            return False
+        import jax
+        for k, old in self._saved.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        jax.clear_caches()
+        return False
+
+
+def _trace_views(fn, args) -> tuple[str, dict]:
+    """(stablehlo text, jaxpr primitive histogram) from ONE trace when
+    the AOT `.trace()` API is available, else two."""
+    import jax
+    jitted = jax.jit(fn)
+    if hasattr(jitted, "trace"):
+        traced = jitted.trace(*args)
+        txt = traced.lower().as_text()
+        from collections import Counter
+        hist: Counter = Counter()
+        hlo._walk_jaxpr(traced.jaxpr.jaxpr, hist)
+        return txt, dict(hist)
+    return (jitted.lower(*args).as_text(),
+            hlo.jaxpr_primitives(fn, *args))
+
+
+def check_text(txt: str, kb: dict, file: str, text: str = "",
+               prims: Optional[dict] = None,
+               label: str = "") -> list[Finding]:
+    """Evaluate one kernel budget against already-lowered StableHLO
+    text (and optionally a jaxpr primitive histogram). Pure — the
+    self-test feeds committed bad-pattern fixtures through here."""
+    name = kb["entry"] + (f"[{label}]" if label else "")
+    anchor = kb["entry"]
+    ln = lambda key: _line_of(text, anchor, key) if text else 1  # noqa: E731
+    out: list[Finding] = []
+    ops = hlo.op_histogram(txt)
+
+    sorts = kb.get("sorts")
+    if sorts is not None:
+        ar = hlo.sort_arities(txt)
+        want = sorts.get("count")
+        if want is not None and len(ar) != want:
+            out.append(Finding(core.SORT_COUNT, file, ln("count"),
+                               f"expected exactly {want} stablehlo.sort "
+                               f"ops, lowering has {len(ar)}", name))
+        per = sorts.get("operands_per_sort")
+        if per is not None and any(x != per for x in ar):
+            out.append(Finding(core.SORT_ARITY, file,
+                               ln("operands_per_sort"),
+                               f"expected {per} operands per sort, "
+                               f"got arities {ar}", name))
+        tot = sorts.get("operands_total")
+        if tot is not None and sum(ar) != tot:
+            out.append(Finding(core.SORT_ARITY, file,
+                               ln("operands_total"),
+                               f"expected {tot} total sorted operands, "
+                               f"got {sum(ar)} ({ar})", name))
+
+    for op, ceil in (kb.get("ceilings") or {}).items():
+        got = ops.get(op, 0)
+        if got > ceil:
+            out.append(Finding(core.OP_CEILING, file, ln(op),
+                               f"stablehlo.{op} count {got} exceeds "
+                               f"ceiling {ceil}", name))
+
+    for dt in kb.get("forbid_dtypes", ()):
+        hits = hlo.find_dtype_tensors(txt, dt)
+        if hits:
+            out.append(Finding(core.FORBID_DTYPE, file,
+                               ln("forbid_dtypes"),
+                               f"{len(hits)} {dt} tensor(s) leaked into "
+                               f"the lowering (e.g. {hits[0]})", name))
+
+    patterns = tuple(kb.get("forbid_ops", ()))
+    if patterns:
+        bad = [t for t in hlo.custom_call_targets(txt)
+               if any(p in t for p in patterns)]
+        if prims is not None:
+            bad += hlo.forbidden_primitives(prims, patterns)
+        if bad:
+            out.append(Finding(core.FORBID_OP, file, ln("forbid_ops"),
+                               f"forbidden op(s) in jitted path: "
+                               f"{sorted(set(bad))}", name))
+    return out
+
+
+def check_kernel(kb: dict, file: str, text: str = "") -> list[Finding]:
+    """Build the kernel's registered entry, trace it (and its
+    variants), and evaluate every budget in ``kb``."""
+    spec = entries.get(kb["entry"])
+    with _Env(kb.get("env")):
+        built = spec.build()
+        txt, prims = _trace_views(built["fn"], built["args"])
+        out = check_text(txt, kb, file, text, prims)
+        variants = built.get("variants") or {}
+        if kb.get("lane_invariance") and variants:
+            base_hist = hlo.op_histogram(txt)
+            for label, (vfn, vargs) in variants.items():
+                vtxt, vprims = _trace_views(vfn, vargs)
+                out += check_text(vtxt, kb, file, text, vprims, label)
+                vhist = hlo.op_histogram(vtxt)
+                if vhist != base_hist:
+                    diff = {op: (base_hist.get(op, 0), vhist.get(op, 0))
+                            for op in set(base_hist) | set(vhist)
+                            if base_hist.get(op, 0) != vhist.get(op, 0)}
+                    out.append(Finding(
+                        core.LANE_INVARIANCE, file,
+                        _line_of(text, kb["entry"], "lane_invariance")
+                        if text else 1,
+                        f"op structure differs between lane widths "
+                        f"(variant {label}): {diff}", kb["entry"]))
+    allow = set(kb.get("allow", ()))
+    return [f for f in out if f.rule not in allow]
+
+
+def run_budgets(files=None, only_entry: Optional[str] = None
+                ) -> list[Finding]:
+    """Evaluate budget files (default: every kernel-type JSON in
+    `BUDGET_DIR`) and return the surviving findings."""
+    if files is None:
+        files = sorted(p for p in BUDGET_DIR.glob("*.json"))
+    out: list[Finding] = []
+    for path in files:
+        kernels, text = load_budget_file(path)
+        for kb in kernels:
+            if only_entry is not None and kb["entry"] != only_entry:
+                continue
+            out += check_kernel(kb, str(path), text)
+    return out

@@ -1,0 +1,102 @@
+"""Shared vocabulary of the static-analysis subsystem: findings,
+rule ids, and the suppression syntax.
+
+A *finding* is one violation: a rule id, a ``file:line`` anchor, and a
+human message. The three passes (budget engine, retrace-drift
+detector, lock-order lint) all emit findings; `scripts/analyze.py
+--gate` exits non-zero iff any UNSUPPRESSED finding survives.
+
+Suppression syntax (the only escape hatch, so every waiver is
+greppable)::
+
+    some_code()          # analysis: allow(jit-under-lock)
+
+The comment applies to its own line, the line directly above the
+flagged one, or — for findings inside a ``with`` block — the ``with``
+statement's line (block scope). Budget/retrace rules are suppressed
+declaratively instead, via an ``"allow": [...]`` list in the JSON
+budget entry, so the waiver lives next to the number it waives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# -- rule catalog -----------------------------------------------------------
+# budget engine (pass 1)
+SORT_COUNT = "sort-count"            # exact stablehlo.sort op count
+SORT_ARITY = "sort-arity"            # operands per sort / total sorted operands
+OP_CEILING = "op-ceiling"            # gather/scatter/dynamic_slice/while ceilings
+FORBID_DTYPE = "forbid-dtype"        # e.g. i64 tensors with x64 off
+FORBID_OP = "forbid-op"              # host callbacks etc. in jitted paths
+LANE_INVARIANCE = "lane-invariance"  # bits-path op structure free of lane width
+
+# retrace-drift detector (pass 2)
+RETRACE_DRIFT = "retrace-drift"          # one plan-cache slot, >1 jit cache key
+RETRACE_PY_SCALAR = "retrace-py-scalar"  # raw Python scalar in a traced position
+RETRACE_EXTRA_COMPILE = "retrace-extra-compile"  # compile count != committed
+
+# lock-order / threading lint (pass 3)
+LOCK_CYCLE = "lock-cycle"            # ordering cycle in the lock graph
+JIT_UNDER_LOCK = "jit-under-lock"    # blocking jax dispatch while a lock is held
+BARE_ACQUIRE = "bare-acquire"        # .acquire() without try/finally release
+
+ALL_RULES = (
+    SORT_COUNT, SORT_ARITY, OP_CEILING, FORBID_DTYPE, FORBID_OP,
+    LANE_INVARIANCE, RETRACE_DRIFT, RETRACE_PY_SCALAR,
+    RETRACE_EXTRA_COMPILE, LOCK_CYCLE, JIT_UNDER_LOCK, BARE_ACQUIRE,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a file:line."""
+
+    rule: str
+    file: str
+    line: int
+    message: str
+    entry: str = ""      # kernel/entry-point name when one applies
+
+    def format(self) -> str:
+        where = f"{self.file}:{self.line}"
+        tag = f" ({self.entry})" if self.entry else ""
+        return f"{where}: [{self.rule}]{tag} {self.message}"
+
+
+_ALLOW_RE = re.compile(r"#\s*analysis:\s*allow\(([^)]*)\)")
+
+
+def scan_suppressions(source: str) -> dict[int, set[str]]:
+    """Map 1-indexed line -> set of rule ids waived on that line."""
+    out: dict[int, set[str]] = {}
+    for i, ln in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_RE.search(ln)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = rules
+    return out
+
+
+def is_suppressed(finding: Finding, suppressions: dict[int, set[str]],
+                  scope_lines: tuple[int, ...] = ()) -> bool:
+    """True iff the finding's rule is waived on its own line, the line
+    above it, or any of the caller-provided ``scope_lines`` (the lint
+    passes the enclosing ``with`` statement lines)."""
+    for ln in (finding.line, finding.line - 1, *scope_lines):
+        rules = suppressions.get(ln)
+        if rules and (finding.rule in rules or "*" in rules):
+            return True
+    return False
+
+
+def format_report(findings: list[Finding], header: str = "") -> str:
+    lines = []
+    if header:
+        lines.append(header)
+    if not findings:
+        lines.append("  no findings")
+    for f in findings:
+        lines.append("  " + f.format())
+    return "\n".join(lines)

@@ -1,0 +1,303 @@
+"""Registered analysis entry points: the kernels the budget engine
+lowers and checks.
+
+Each entry is a named `EntrySpec` whose `build()` returns the jittable
+callable plus concrete fixture arguments (small, deterministic, built
+once and cached — trace-only lowering never executes them). Entries
+may also expose *variants*: alternate arguments whose LOWERED OP
+STRUCTURE must be identical to the primary one (the bits path's
+lane-width invariance: lanes ride array shapes, never Python
+unrolling).
+
+Budget JSON files under `analysis/budgets/` reference entries by
+name; `budget.run_budgets()` joins the two. Fixtures mirror the
+shapes `tests/test_hlo_passes.py` historically pinned so the ported
+ceilings keep their meaning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import numpy as np
+
+_REGISTRY: dict[str, "EntrySpec"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    """One analyzable kernel entry point."""
+
+    name: str
+    build: Callable[[], dict]    # -> {"fn":..., "args":..., "variants":{...}}
+    doc: str = ""
+
+
+def register(name: str, doc: str = ""):
+    def deco(build):
+        _REGISTRY[name] = EntrySpec(name, build, doc)
+        return build
+    return deco
+
+
+def get(name: str) -> EntrySpec:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown analysis entry {name!r}; registered: "
+            f"{sorted(_REGISTRY)}") from None
+
+
+def names() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# fixtures (deterministic, cached; tiny — lowering only, never executed
+# beyond construction)
+# ---------------------------------------------------------------------------
+
+def _rng():
+    return np.random.default_rng(42)
+
+
+@functools.lru_cache(maxsize=None)
+def _tile_pair():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S  # noqa: F401
+    from combblas_tpu.ops import tile as T
+    rng = _rng()
+
+    def one():
+        d = rng.random((40, 40))
+        d[rng.random((40, 40)) > 0.3] = 0
+        return T.from_dense(jnp.asarray(d.astype(np.float32)),
+                            jnp.asarray(0.0, jnp.float32), cap=600)
+    return one(), one()
+
+
+@functools.lru_cache(maxsize=None)
+def _big_tile():
+    """Tile whose FULL fused-key space overflows 2^31 — the window-
+    relative codec must keep spgemm_colwindow on i32 keys."""
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.ops import tile as T
+    rng = _rng()
+    big, n = 1 << 17, 200
+    r = jnp.asarray(rng.integers(0, big, n), jnp.int32)
+    c = jnp.asarray(rng.integers(0, big, n), jnp.int32)
+    v = jnp.ones((n,), jnp.float32)
+    t = T.from_coo(S.PLUS, r, c, v, nrows=big, ncols=big, cap=256)
+    assert T.fused_key_info(big, big) is None  # whole-tile key: no i32 dtype
+    return t
+
+
+@functools.lru_cache(maxsize=None)
+def _graph_fixture():
+    """256-vertex pattern-symmetric boolean graph on a 1x1 grid, with
+    a routed BFS plan eligible for the packed-bit batch path."""
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as DM
+    from combblas_tpu.parallel.grid import ProcGrid
+    rng = _rng()
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    n = 256
+    r = rng.integers(0, n, 600).astype(np.int32)
+    c = rng.integers(0, n, 600).astype(np.int32)
+    rows = np.concatenate([r, c])
+    cols = np.concatenate([c, r])
+    a = DM.from_global_coo(S.LOR, grid, jnp.asarray(rows),
+                           jnp.asarray(cols),
+                           jnp.ones(len(rows), jnp.bool_), n, n)
+    plan = B.plan_bfs(a, route=True)
+    assert B.bits_batch_ok(a, plan), "graph fixture must be bits-eligible"
+    return a, plan
+
+
+@functools.lru_cache(maxsize=None)
+def _spmv_fixture():
+    """64-vertex float32 matrix + column-aligned operand vector on a
+    1x1 grid (the serve engine's mesh for the batch executors)."""
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as DM
+    from combblas_tpu.parallel import distvec as dv
+    from combblas_tpu.parallel.grid import COL_AXIS, ProcGrid
+    rng = _rng()
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    n = 64
+    r = jnp.asarray(rng.integers(0, n, 300), jnp.int32)
+    c = jnp.asarray(rng.integers(0, n, 300), jnp.int32)
+    a = DM.from_global_coo(S.PLUS, grid, r, c,
+                           jnp.ones((300,), jnp.float32), n, n)
+    x = dv.from_global(grid, COL_AXIS, jnp.asarray(
+        rng.random(n).astype(np.float32)), block=a.tile_n)
+    return a, x
+
+
+@functools.lru_cache(maxsize=None)
+def _route_fixture():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import route as R
+    rng = _rng()
+    npad = 256
+    perm = rng.permutation(npad).astype(np.int64)
+    rp = R.plan_route(perm)
+    words = {w: jnp.asarray(
+        rng.integers(0, 1 << 32, (npad // 32, w), dtype=np.uint64)
+        .astype(np.uint32)) for w in (8, 16)}
+    return rp, words
+
+
+# ---------------------------------------------------------------------------
+# entries: ESC SpGEMM pipeline
+# ---------------------------------------------------------------------------
+
+@register("esc.spgemm", "ESC SpGEMM A*B on the default fused-key path")
+def _esc_spgemm():
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.ops import tile as T
+    a, b = _tile_pair()
+    fn = lambda a, b: T.spgemm(S.PLUS_TIMES_F32, a, b,   # noqa: E731
+                               flops_cap=4096, out_cap=1024)
+    return {"fn": fn, "args": (a, b)}
+
+
+@register("esc.spgemm_2key", "reference 2-key ESC path "
+          "(COMBBLAS_TPU_FUSED_KEY=0): 3 operands per sort")
+def _esc_spgemm_2key():
+    return _esc_spgemm()          # env override comes from the budget file
+
+
+@register("esc.colwindow", "windowed SpGEMM with the window-relative "
+          "i32 key codec (full key space overflows 2^31)")
+def _esc_colwindow():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.ops import tile as T
+    t = _big_tile()
+
+    def fn(t, clo, chi):
+        return T.spgemm_colwindow(S.PLUS_TIMES_F32, t, t, clo, chi,
+                                  flops_cap=2048, out_cap=512,
+                                  win_width=128)
+    return {"fn": fn,
+            "args": (t, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(128, jnp.int32))}
+
+
+# ---------------------------------------------------------------------------
+# entries: SpMV / SpMM
+# ---------------------------------------------------------------------------
+
+@register("spmv.plus_times_f32", "distributed dense-vector SpMV")
+def _spmv():
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import spmv as SV
+    a, x = _spmv_fixture()
+    return {"fn": lambda a, x: SV.spmv(S.PLUS_TIMES_F32, a, x),
+            "args": (a, x)}
+
+
+@register("spmm.plus_times_f32", "serve-engine SpMM: stacked operand "
+          "columns through densemat.spmm (the spmv batch executor)")
+def _spmm():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import densemat as dmm
+    from combblas_tpu.parallel.grid import COL_AXIS
+    a, _ = _spmv_fixture()
+    sr = S.PLUS_TIMES_F32
+    grid, tn, glen = a.grid, a.tile_n, a.ncols
+
+    def fn(a, arr):                         # arr: (glen, W) — engine shape
+        data = jnp.pad(arr, ((0, grid.pc * tn - glen), (0, 0)))
+        x = dmm.DistMultiVec(
+            data.reshape(grid.pc, tn, arr.shape[1]), grid, COL_AXIS, glen)
+        return dmm.spmm(sr, a, x).data
+
+    arr = jnp.zeros((glen, 4), jnp.float32)
+    return {"fn": fn, "args": (a, arr)}
+
+
+# ---------------------------------------------------------------------------
+# entries: BFS batch cores
+# ---------------------------------------------------------------------------
+
+@register("bfs.batch_dense", "dense-column multi-source BFS core "
+          "(one while loop for the whole batch)")
+def _bfs_batch():
+    import jax.numpy as jnp
+
+    from combblas_tpu.models import bfs as B
+    a, plan = _graph_fixture()
+    ml = jnp.int32(1 << 30)
+    fn = lambda roots, ml: B.bfs_batch(a, roots, ml, plan=plan)  # noqa: E731
+    return {"fn": fn, "args": (jnp.zeros((4,), jnp.int32), ml)}
+
+
+@register("bfs.bits_core", "packed-bit multi-root BFS core: bitplane "
+          "frontiers, 32 roots per word; lane-width invariant")
+def _bfs_bits_core():
+    import jax.numpy as jnp
+
+    from combblas_tpu.models import bfs as B
+    a, plan = _graph_fixture()
+    ml = jnp.int32(1 << 30)
+    fn = lambda roots, ml: B._bfs_batch_bits_core(  # noqa: E731
+        a, plan, roots, ml)
+    return {"fn": fn,
+            "args": (jnp.zeros((8,), jnp.int32), ml),
+            "variants": {"W=16": (fn, (jnp.zeros((16,), jnp.int32), ml))}}
+
+
+# ---------------------------------------------------------------------------
+# entries: bitseg / route multi-lane primitives
+# ---------------------------------------------------------------------------
+
+@register("bitseg.multi", "lane-parallel segmented OR scan+fill over an "
+          "(nwords, W) bitplane matrix")
+def _bitseg_multi():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import bitseg as BS
+    rng = _rng()
+    nwords = 64
+
+    def fn(x, starts):
+        return (BS.seg_or_scan_bits_multi(x, starts),
+                BS.seg_or_fill_bits_multi(x, starts))
+
+    def mk(w):
+        x = jnp.asarray(rng.integers(0, 1 << 32, (nwords, w),
+                                     dtype=np.uint64).astype(np.uint32))
+        s = jnp.asarray(rng.integers(0, 1 << 32, (nwords,),
+                                     dtype=np.uint64).astype(np.uint32))
+        return (x, s)
+
+    return {"fn": fn, "args": mk(8), "variants": {"W=16": (fn, mk(16))}}
+
+
+@register("route.multi", "Benes-network lane-matrix route: one shared "
+          "mask decompaction serves every lane")
+def _route_multi():
+    from combblas_tpu.ops import route as R
+    rp, words = _route_fixture()
+    fn = lambda w: R.apply_route_multi(rp, w)   # noqa: E731
+    return {"fn": fn, "args": (words[8],),
+            "variants": {"W=16": (fn, (words[16],))}}

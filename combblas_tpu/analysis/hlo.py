@@ -1,0 +1,108 @@
+"""Walkers over closed jaxprs and unoptimized StableHLO text.
+
+The budget engine inspects the GENERATED program, not the source
+(JITSPMM, arxiv 2312.05639: what matters is what the compiler was
+handed). Two complementary views:
+
+* the unoptimized StableHLO lowering (`lower_text`): op counts here
+  are stable across XLA versions (no fusion heuristics run yet) and
+  in 1:1 correspondence with the jnp-level ops a kernel emits — the
+  right place to pin sort counts, sorted-operand arity, and
+  gather/scatter/while ceilings;
+* the closed jaxpr (`jaxpr_primitives`): the right place to catch
+  forbidden PRIMITIVES — `pure_callback`/`io_callback` smuggled into
+  a jitted path keeps its name in the jaxpr but lowers to an opaque
+  `stablehlo.custom_call`, so the jaxpr view is the reliable one.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+import jax
+
+
+def lower_text(fn, *args) -> str:
+    """Unoptimized StableHLO text of ``jit(fn)(*args)`` — trace only,
+    nothing is compiled or executed."""
+    return jax.jit(fn).lower(*args).as_text()
+
+
+def op_histogram(txt: str) -> dict[str, int]:
+    """{stablehlo op name: count}. Matches both the quoted generic
+    form (``"stablehlo.sort"(...)``) and the pretty-printed form
+    (``stablehlo.while``)."""
+    return dict(Counter(re.findall(r"stablehlo\.([A-Za-z0-9_]+)", txt)))
+
+
+def count_op(txt: str, op: str) -> int:
+    return op_histogram(txt).get(op, 0)
+
+
+def sort_arities(txt: str) -> list[int]:
+    """Operand count of each stablehlo.sort (the sorted-bytes knob:
+    the fused-key ESC pipeline carries key+payload = 2; the legacy
+    2-key path carries row+col+payload = 3)."""
+    return [m.group(1).count("%")
+            for m in re.finditer(r'"stablehlo\.sort"\(([^)]*)\)', txt)]
+
+
+def find_dtype_tensors(txt: str, dtype: str) -> list[str]:
+    """Tensor TYPES of the given element dtype (e.g. "i64") — not MLIR
+    attribute metadata: scalar literals like ``0 : i64`` never match
+    the tensor<> pattern, and dense attribute literals (e.g. a
+    collective's ``replica_groups = dense<0> : tensor<1x1xi64>``) are
+    stripped first — they are compile-time metadata, not device
+    arrays."""
+    txt = re.sub(rf"dense<[^>]*>\s*:\s*tensor<[0-9x]*{dtype}>", "", txt)
+    return re.findall(rf"tensor<[0-9x]*{dtype}>", txt)
+
+
+def custom_call_targets(txt: str) -> list[str]:
+    return re.findall(r'call_target_name\s*=\s*"([^"]+)"', txt)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _walk_jaxpr(jaxpr, hist: Counter) -> None:
+    for eqn in jaxpr.eqns:
+        hist[eqn.primitive.name] += 1
+        for v in eqn.params.values():
+            _walk_param(v, hist)
+
+
+def _walk_param(v, hist: Counter) -> None:
+    # sub-jaxprs hide under many param names (jaxpr, call_jaxpr,
+    # cond_jaxpr, body_jaxpr, branches tuples, ...): duck-walk anything
+    # that looks like a (Closed)Jaxpr, recurse into tuples/lists
+    if hasattr(v, "eqns"):
+        _walk_jaxpr(v, hist)
+    elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+        _walk_jaxpr(v.jaxpr, hist)
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            _walk_param(x, hist)
+
+
+def jaxpr_primitives(fn, *args) -> dict[str, int]:
+    """{primitive name: count} over the closed jaxpr of fn(*args),
+    including every nested sub-jaxpr (while bodies, cond branches,
+    inner pjit calls)."""
+    closed = jax.make_jaxpr(fn)(*args)
+    hist: Counter = Counter()
+    _walk_jaxpr(closed.jaxpr, hist)
+    return dict(hist)
+
+
+def forbidden_primitives(prims: dict[str, int],
+                         patterns: tuple[str, ...]) -> list[str]:
+    """Primitive names matching any forbidden substring pattern (e.g.
+    "callback" catches pure_callback/io_callback/debug_callback)."""
+    out = []
+    for name in sorted(prims):
+        if any(pat in name for pat in patterns):
+            out.append(name)
+    return out

@@ -1,0 +1,513 @@
+"""Pass 3 — lock-order / threading lint.
+
+An AST pass over the package that builds the lock-acquisition graph
+and reports the three deadlock shapes that have actually bitten this
+codebase (PR 4's ~60%-flaky tier-1 hang was a jit dispatch racing
+worker collectives under a shared lock):
+
+* `lock-cycle` — two code paths acquire the same pair of locks in
+  opposite orders (or re-acquire a non-reentrant lock they already
+  hold);
+* `jit-under-lock` — a blocking jax dispatch (any `jax.*`/`jnp.*`
+  call, or a known kernel driver like `fastsv`/`plan_bfs`/`spmm`)
+  while a lock is held: every other thread needing that lock now
+  waits on device latency, and on the CPU mesh a concurrent
+  collective deadlocks outright;
+* `bare-acquire` — `.acquire()` without a try/finally release: an
+  exception between the two leaks the lock forever.
+
+Scope and resolution are deliberately conservative: locks are
+`threading.Lock/RLock/Condition` attributes (a Condition constructed
+over a lock aliases that lock); held-ness is lexical (`with lock:`
+nesting); calls resolve interprocedurally only through RECEIVERS WITH
+KNOWN TYPES (`self.queue = RequestQueue(...)` makes `self.queue.put`
+resolve to `RequestQueue.put`) plus module aliases — name-guessing
+across untyped receivers would drown the report in noise. Lock
+closures are transitive over resolved calls.
+
+Waive a finding with ``# analysis: allow(<rule>)`` on the flagged
+line, the line above, or the enclosing ``with`` statement's line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Optional
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+
+#: terminal call names treated as blocking device dispatch even when
+#: the receiver cannot be typed (the repo's kernel drivers)
+DISPATCH_NAMES = frozenset({
+    "fastsv", "bfs", "bfs_batch", "bfs_batch_bits", "bfs_bits",
+    "bfs_bits_mesh", "spgemm", "spgemm_phased", "spgemm_colwindow",
+    "spmm", "spmv", "spmsv", "plan_bfs", "block_until_ready",
+    "device_put", "jit",
+})
+
+#: obs factory terminals -> the metric class their result carries
+FACTORY_TYPES = {"counter": "Counter", "gauge": "Gauge",
+                 "histogram": "Histogram"}
+
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+_LOCK_METHODS_IGNORED = frozenset({
+    "release", "wait", "wait_for", "notify", "notify_all", "locked"})
+
+
+def _dotted(node) -> Optional[list[str]]:
+    """Attribute chain as names: self.queue.put -> [self, queue, put]."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    module: str
+    locks: dict = dataclasses.field(default_factory=dict)
+    # attr -> (canonical id, kind); Condition-over-lock aliases resolve
+    # to the aliased lock's canonical id
+    attr_types: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallEvent:
+    line: int
+    held: tuple                      # ((lock id, with line), ...)
+    terminal: str
+    target: Optional[tuple] = None   # ("method", class, name) when typed
+    jax_rooted: bool = False
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    key: tuple                       # (module, class or "", name)
+    file: str
+    direct_locks: set = dataclasses.field(default_factory=set)
+    acquires: list = dataclasses.field(default_factory=list)
+    # (lock id, line, held tuple)
+    calls: list = dataclasses.field(default_factory=list)
+    bare: list = dataclasses.field(default_factory=list)
+    # (lock id, line, held tuple) for .acquire() without try/finally
+
+
+class _Module:
+    def __init__(self, path: pathlib.Path, pkg_root: pathlib.Path):
+        self.path = path
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=str(path))
+        try:
+            rel = path.relative_to(pkg_root.parent)
+            self.name = str(rel.with_suffix("")).replace("/", ".")
+        except ValueError:
+            self.name = path.stem
+        self.aliases: dict[str, str] = {}      # local name -> dotted module/obj
+        self.module_locks: dict[str, tuple] = {}   # var -> (canonical, kind)
+        self.module_var_types: dict[str, str] = {}
+        self.suppressions = core.scan_suppressions(self.source)
+
+
+class Analyzer:
+    def __init__(self, paths):
+        self.modules: list[_Module] = []
+        self.classes: dict[str, ClassInfo] = {}
+        self.lock_kinds: dict[str, str] = {}
+        self.funcs: dict[tuple, FuncInfo] = {}
+        roots = [pathlib.Path(p) for p in paths]
+        for root in roots:
+            files = ([root] if root.is_file()
+                     else sorted(root.rglob("*.py")))
+            for f in files:
+                self.modules.append(_Module(f, root if root.is_dir()
+                                            else root.parent))
+
+    # -- phase 1: imports, lock attrs, attr types ----------------------
+
+    def _collect_imports(self, m: _Module) -> None:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    m.aliases[al.asname or al.name.split(".")[0]] = al.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for al in node.names:
+                    m.aliases[al.asname or al.name] = (
+                        f"{node.module}.{al.name}")
+
+    def _lock_ctor(self, call: ast.Call, m: _Module) -> Optional[str]:
+        d = _dotted(call.func)
+        if not d:
+            return None
+        root = m.aliases.get(d[0], d[0])
+        full = ".".join([root] + d[1:])
+        for ctor, kind in _LOCK_CTORS.items():
+            if full == f"threading.{ctor}":
+                return kind
+        return None
+
+    def _collect_class(self, m: _Module, cls: ast.ClassDef) -> None:
+        info = self.classes.setdefault(cls.name,
+                                       ClassInfo(cls.name, m.name))
+        for fn in [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign) or not node.targets:
+                    continue
+                tgt = node.targets[0]
+                d = _dotted(tgt)
+                if not (d and len(d) == 2 and d[0] == "self"):
+                    continue
+                attr = d[1]
+                val = node.value
+                if not isinstance(val, ast.Call):
+                    continue
+                kind = self._lock_ctor(val, m)
+                if kind == "Condition" and val.args:
+                    base = _dotted(val.args[0])
+                    if (base and len(base) == 2 and base[0] == "self"
+                            and base[1] in info.locks):
+                        # Condition over an existing lock: alias it
+                        info.locks[attr] = info.locks[base[1]]
+                        continue
+                if kind is not None:
+                    cid = f"{cls.name}.{attr}"
+                    info.locks[attr] = (cid, kind)
+                    self.lock_kinds[cid] = kind
+                    continue
+                ctor = _dotted(val.func)
+                if ctor and ctor[-1] in FACTORY_TYPES and len(ctor) > 1:
+                    info.attr_types[attr] = FACTORY_TYPES[ctor[-1]]
+                elif ctor and ctor[-1][:1].isupper():
+                    info.attr_types[attr] = ctor[-1]
+
+    def _collect_module_scope(self, m: _Module) -> None:
+        for node in m.tree.body:
+            if not isinstance(node, ast.Assign) or not node.targets:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            val = node.value
+            if not isinstance(val, ast.Call):
+                continue
+            kind = self._lock_ctor(val, m)
+            if kind is not None:
+                cid = f"{m.name}.{tgt.id}"
+                m.module_locks[tgt.id] = (cid, kind)
+                self.lock_kinds[cid] = kind
+                continue
+            ctor = _dotted(val.func)
+            if ctor and ctor[-1] in FACTORY_TYPES:
+                m.module_var_types[tgt.id] = FACTORY_TYPES[ctor[-1]]
+            elif ctor and ctor[-1][:1].isupper():
+                m.module_var_types[tgt.id] = ctor[-1]
+
+    # -- phase 2: per-function walks -----------------------------------
+
+    def _lock_ref(self, expr, m: _Module,
+                  cls: Optional[ClassInfo]) -> Optional[str]:
+        d = _dotted(expr)
+        if not d:
+            return None
+        if (cls is not None and len(d) == 2 and d[0] == "self"
+                and d[1] in cls.locks):
+            return cls.locks[d[1]][0]
+        if len(d) == 1 and d[0] in m.module_locks:
+            return m.module_locks[d[0]][0]
+        return None
+
+    def _walk_function(self, m: _Module, cls: Optional[ClassDef],
+                       fn, fi: FuncInfo) -> None:
+        local_types: dict[str, str] = dict(m.module_var_types)
+
+        def resolve_call(call: ast.Call) -> CallEvent:
+            d = _dotted(call.func)
+            ev = CallEvent(call.lineno, (), d[-1] if d else "<expr>")
+            if not d:
+                return ev
+            root = d[0]
+            if root == "self" and cls is not None:
+                if len(d) == 2:
+                    ev.target = ("method", cls.name, d[1])
+                elif len(d) == 3 and d[1] in cls.attr_types:
+                    ev.target = ("method", cls.attr_types[d[1]], d[2])
+            elif root in local_types and len(d) == 2:
+                ev.target = ("method", local_types[root], d[1])
+            elif root in m.aliases:
+                full = ".".join([m.aliases[root]] + d[1:])
+                if full == "jax" or full.startswith(("jax.",)):
+                    ev.jax_rooted = True
+            return ev
+
+        def scan(node, held):
+            """Record calls/acquires in ``node`` without descending
+            into nested function/lambda bodies (they run later, not
+            under these locks)."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and len(d) >= 2 and d[-1] == "acquire":
+                    lid = self._lock_ref(node.func.value, m, cls)
+                    if lid is not None:
+                        fi.direct_locks.add(lid)
+                        fi.acquires.append((lid, node.lineno, held))
+                        if not self._release_in_finally(m, node, lid,
+                                                        cls):
+                            fi.bare.append((lid, node.lineno, held))
+                        for a in node.args:
+                            scan(a, held)
+                        return
+                if d and len(d) >= 2 and d[-1] in _LOCK_METHODS_IGNORED:
+                    if self._lock_ref(node.func.value, m, cls):
+                        for a in node.args:
+                            scan(a, held)
+                        return
+                ev = resolve_call(node)
+                ev.held = held
+                fi.calls.append(ev)
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                ctor = _dotted(node.value.func)
+                if (ctor and len(ctor) == 1 and ctor[0] in self.classes
+                        and node.targets
+                        and isinstance(node.targets[0], ast.Name)):
+                    local_types[node.targets[0].id] = ctor[0]
+            for child in ast.iter_child_nodes(node):
+                scan(child, held)
+
+        def walk_stmts(stmts, held):
+            for st in stmts:
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    new = list(held)
+                    for item in st.items:
+                        lid = self._lock_ref(item.context_expr, m, cls)
+                        if lid is not None:
+                            fi.direct_locks.add(lid)
+                            fi.acquires.append(
+                                (lid, st.lineno, tuple(new)))
+                            new.append((lid, st.lineno))
+                        else:
+                            scan(item.context_expr, tuple(new))
+                    walk_stmts(st.body, tuple(new))
+                elif isinstance(st, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue            # nested defs analyzed separately? no
+                elif isinstance(st, ast.Try):
+                    scan_parts = (st.body, st.orelse, st.finalbody)
+                    for part in scan_parts:
+                        walk_stmts(part, held)
+                    for h in st.handlers:
+                        walk_stmts(h.body, held)
+                elif isinstance(st, (ast.If, ast.For, ast.AsyncFor,
+                                     ast.While)):
+                    scan(getattr(st, "test", None) or
+                         getattr(st, "iter", None), held)
+                    walk_stmts(st.body, held)
+                    walk_stmts(st.orelse, held)
+                else:
+                    scan(st, held)
+
+        walk_stmts(fn.body, ())
+
+    def _release_in_finally(self, m: _Module, acq: ast.Call, lid: str,
+                            cls) -> bool:
+        """True iff this .acquire() is paired with a try/finally
+        release: either an ancestor Try releases it in finalbody, or
+        the statement right after the acquire is such a Try."""
+        parents = getattr(self, "_parents", None)
+        if parents is None:
+            return False
+        node = acq
+
+        def releases(try_node) -> bool:
+            for n in try_node.finalbody:
+                for c in ast.walk(n):
+                    if (isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr == "release"
+                            and self._lock_ref(c.func.value, m, cls)
+                            == lid):
+                        return True
+            return False
+
+        # ancestor Trys
+        cur = node
+        stmt = None
+        while cur in parents:
+            cur = parents[cur]
+            if stmt is None and isinstance(cur, ast.stmt):
+                stmt = cur
+            if isinstance(cur, ast.Try) and releases(cur):
+                return True
+        # next-sibling Try
+        if stmt is not None and stmt in parents:
+            body = getattr(parents[stmt], "body", [])
+            if stmt in body:
+                i = body.index(stmt)
+                for nxt in body[i + 1:]:
+                    if isinstance(nxt, ast.Try):
+                        return releases(nxt)
+                    break
+        return False
+
+    # -- phase 3/4: closure, edges, findings ---------------------------
+
+    def run(self) -> list[tuple[Finding, tuple]]:
+        """Analyze; returns (finding, scope_lines) pairs — scope lines
+        are the enclosing-with lines eligible to carry a suppression.
+        Use `run_lockorder` for the suppression-filtered list."""
+        for m in self.modules:
+            self._collect_imports(m)
+            for node in m.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._collect_class(m, node)
+            self._collect_module_scope(m)
+
+        for m in self.modules:
+            self._parents = {c: p for p in ast.walk(m.tree)
+                             for c in ast.iter_child_nodes(p)}
+            for node in m.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    cls = self.classes[node.name]
+                    for fn in node.body:
+                        if isinstance(fn, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                            fi = FuncInfo((m.name, node.name, fn.name),
+                                          str(m.path))
+                            self.funcs[fi.key] = fi
+                            self._walk_function(m, cls, fn, fi)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    fi = FuncInfo((m.name, "", node.name), str(m.path))
+                    self.funcs[fi.key] = fi
+                    self._walk_function(m, None, node, fi)
+        self._parents = None
+
+        # transitive lock closure over typed calls
+        method_locks: dict[tuple, set] = {
+            (k[1], k[2]): set(fi.direct_locks)
+            for k, fi in self.funcs.items()}
+        for k, fi in self.funcs.items():
+            method_locks.setdefault((k[1], k[2]), set()).update(
+                fi.direct_locks)
+        changed = True
+        while changed:
+            changed = False
+            for k, fi in self.funcs.items():
+                mine = method_locks[(k[1], k[2])]
+                for ev in fi.calls:
+                    if ev.target and ev.target[0] == "method":
+                        tgt = (ev.target[1], ev.target[2])
+                        extra = method_locks.get(tgt, set()) - mine
+                        if extra:
+                            mine |= extra
+                            changed = True
+
+        results: list[tuple[Finding, tuple]] = []
+        edges: dict[tuple, tuple] = {}   # (src, dst) -> (file, line, scope)
+
+        def add_edge(src, dst, file, line, scope):
+            if src == dst:
+                if self.lock_kinds.get(src) != "RLock":
+                    results.append((Finding(
+                        core.LOCK_CYCLE, file, line,
+                        f"non-reentrant lock {src} acquired while "
+                        f"already held (self-deadlock)"), scope))
+                return
+            edges.setdefault((src, dst), (file, line, scope))
+
+        for k, fi in self.funcs.items():
+            for lid, line, held in fi.bare:
+                results.append((Finding(
+                    core.BARE_ACQUIRE, fi.file, line,
+                    f"{lid}.acquire() without try/finally release — "
+                    f"an exception here leaks the lock"), ()))
+            for lid, line, held in fi.acquires:
+                for hlid, hline in held:
+                    add_edge(hlid, lid, fi.file, line,
+                             tuple(hl for _, hl in held))
+            for ev in fi.calls:
+                if not ev.held:
+                    continue
+                scope = tuple(hl for _, hl in ev.held)
+                if ev.jax_rooted or ev.terminal in DISPATCH_NAMES:
+                    heldnames = ", ".join(l for l, _ in ev.held)
+                    results.append((Finding(
+                        core.JIT_UNDER_LOCK, fi.file, ev.line,
+                        f"blocking jax dispatch `{ev.terminal}` while "
+                        f"holding {heldnames}: waiters stall on device "
+                        f"latency; concurrent collectives can deadlock "
+                        f"(the PR-4 hang shape)"), scope))
+                if ev.target and ev.target[0] == "method":
+                    for lid in method_locks.get(
+                            (ev.target[1], ev.target[2]), ()):
+                        for hlid, hline in ev.held:
+                            add_edge(hlid, lid, fi.file, ev.line, scope)
+
+        results += self._find_cycles(edges)
+        return results
+
+    def _find_cycles(self, edges) -> list[tuple[Finding, tuple]]:
+        graph: dict[str, list[str]] = {}
+        for (src, dst) in edges:
+            graph.setdefault(src, []).append(dst)
+        out = []
+        seen_cycles = set()
+        for start in sorted(graph):
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in graph.get(node, ()):
+                    if nxt == start:
+                        cyc = tuple(sorted(path))
+                        if cyc in seen_cycles:
+                            continue
+                        seen_cycles.add(cyc)
+                        sites = []
+                        cycle = path + [start]
+                        for a, b in zip(cycle, cycle[1:]):
+                            f, l, _ = edges[(a, b)]
+                            sites.append(f"{a}->{b} at {f}:{l}")
+                        f0, l0, scope0 = edges[(cycle[0], cycle[1])]
+                        out.append((Finding(
+                            core.LOCK_CYCLE, f0, l0,
+                            "lock-order cycle: " + "; ".join(sites)),
+                            scope0))
+                    elif nxt not in path:
+                        stack.append((nxt, path + [nxt]))
+        return out
+
+
+def run_lockorder(paths=None) -> list[Finding]:
+    """Lint the package (default `combblas_tpu/`); returns findings
+    that survive `# analysis: allow(...)` suppressions."""
+    if paths is None:
+        paths = [pathlib.Path(__file__).parents[1]]
+    an = Analyzer(paths)
+    raw = an.run()
+    sup_cache: dict[str, dict] = {}
+    out = []
+    for finding, scope in raw:
+        sups = sup_cache.get(finding.file)
+        if sups is None:
+            sups = core.scan_suppressions(
+                pathlib.Path(finding.file).read_text())
+            sup_cache[finding.file] = sups
+        if not core.is_suppressed(finding, sups, scope):
+            out.append(finding)
+    return out
+
+
+# keep the annotation import honest for linters
+ClassDef = ast.ClassDef

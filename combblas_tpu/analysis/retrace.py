@@ -1,0 +1,259 @@
+"""Pass 2 — retrace-drift detector.
+
+The serving invariant is "steady-state traffic never compiles": after
+`GraphService.warmup()`, every dispatch must hit the jit cache. jax's
+cache key over non-static args is the abstract signature — dtype,
+shape, and the easily-drifted WEAK-TYPE bit (a raw Python scalar
+traces weak-typed; `jnp.int32(x)` traces strong) — so two call sites
+that `PlanCache` files under one `PlanKey` but that prepare arguments
+differently silently double the compile count.
+
+The detector replays the serve layer's argument-preparation recipes
+(runtime executor AND warmup prefill, per kind x bucket over the
+configured ladder) WITHOUT executing anything, computes each point's
+jit-cache signature, and flags:
+
+* `retrace-drift` — two points in the same plan-cache group with
+  different signatures (the avoidable recompile);
+* `retrace-py-scalar` — a raw Python scalar in a traced position
+  (weak-type leakage waiting to happen);
+* `retrace-extra-compile` — the distinct-signature count per entry
+  differs from the committed expectation in
+  `analysis/budgets/retrace_serve.json` (a bucket-ladder change that
+  silently doubles compiles fails here).
+
+`empirical_compile_count` cross-checks the signature model for a
+callable by actually jitting it with a trace counter — used by the
+tests on cheap entries only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+
+EXPECT_FILE = pathlib.Path(__file__).parent / "budgets" / "retrace_serve.json"
+
+_LANE_W = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepPoint:
+    """One simulated dispatch: ``entry`` names the executable family
+    (expected-compile-count accounting), ``group`` the PlanKey-level
+    identity (points sharing a group MUST share one jit signature),
+    ``origin`` where the args came from (runtime executor / warmup)."""
+
+    entry: str
+    group: str
+    origin: str
+    args: tuple
+
+
+def leaf_signature(leaf) -> tuple:
+    """jit-cache identity of one argument leaf: (dtype, shape,
+    weak_type). Raw Python scalars are weak-typed and tagged."""
+    import jax.numpy as jnp
+    if isinstance(leaf, (bool, int, float, complex)):
+        return ("py-scalar", type(leaf).__name__, (), True)
+    if isinstance(leaf, np.ndarray) or isinstance(leaf, np.generic):
+        return (str(leaf.dtype), tuple(np.shape(leaf)), False)
+    if isinstance(leaf, jnp.ndarray):
+        return (str(leaf.dtype), tuple(leaf.shape),
+                bool(getattr(leaf, "weak_type", False)))
+    # other aval-like leaves (ShapeDtypeStruct)
+    return (str(getattr(leaf, "dtype", type(leaf).__name__)),
+            tuple(getattr(leaf, "shape", ())),
+            bool(getattr(leaf, "weak_type", False)))
+
+
+def signature(args: tuple) -> tuple:
+    """Full jit-cache signature of an argument tuple: pytree structure
+    + per-leaf signatures."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return (str(treedef), tuple(leaf_signature(lf) for lf in leaves))
+
+
+def py_scalar_leaves(args: tuple) -> list[int]:
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten(args)
+    return [i for i, lf in enumerate(leaves)
+            if isinstance(lf, (bool, int, float, complex))]
+
+
+# ---------------------------------------------------------------------------
+# the serve sweep: replicate engine.py's argument preparation exactly
+# ---------------------------------------------------------------------------
+
+def build_serve_sweep(buckets: Optional[tuple] = None,
+                      n: int = 256) -> list[SweepPoint]:
+    """Sweep points for every serve executor over the bucket ladder.
+    Argument recipes mirror `serve/engine.py` line-for-line — if the
+    engine's prep drifts from this model, the empirical cross-check in
+    tests/test_analysis.py catches it."""
+    import jax.numpy as jnp
+
+    from combblas_tpu.utils.config import ServeConfig
+    if buckets is None:
+        buckets = ServeConfig().buckets
+    pts: list[SweepPoint] = []
+    for b in buckets:
+        # bfs dense: _run_bfs pads roots then fn(jnp.asarray(roots_p),
+        # jnp.int32(ml)); warmup: fn(jnp.zeros((eb,), i32), jnp.int32(1))
+        roots = np.zeros((b,), np.int32)
+        pts.append(SweepPoint("bfs-dense", f"bfs-dense/w{b}", "runtime",
+                              (jnp.asarray(roots), jnp.int32(7))))
+        pts.append(SweepPoint("bfs-dense", f"bfs-dense/w{b}", "warmup",
+                              (jnp.zeros((b,), jnp.int32), jnp.int32(1))))
+        # bfs bits: bucket aligns UP to the 32-root lane width, so the
+        # whole ladder shares ONE executable
+        eb = -(-b // _LANE_W) * _LANE_W
+        pts.append(SweepPoint(
+            "bfs-bits", f"bfs-bits/w{eb}", "runtime",
+            (jnp.asarray(np.zeros((eb,), np.int32)), jnp.int32(7))))
+        pts.append(SweepPoint(
+            "bfs-bits", f"bfs-bits/w{eb}", "warmup",
+            (jnp.zeros((eb,), jnp.int32), jnp.int32(1))))
+        # cc: fn(labels, jnp.asarray(verts_p)) vs warmup
+        # fn(labels, jnp.zeros((b,), i32)); labels is a strong i32[n]
+        labels = jnp.zeros((n,), jnp.int32)
+        pts.append(SweepPoint(
+            "cc", f"cc/w{b}", "runtime",
+            (labels, jnp.asarray(np.zeros((b,), np.int32)))))
+        pts.append(SweepPoint(
+            "cc", f"cc/w{b}", "warmup",
+            (labels, jnp.zeros((b,), jnp.int32))))
+        # spmv: run(a, jnp.asarray(arr, sr.dtype)) with arr (glen, W);
+        # the matrix operand is identical either way — model just the
+        # stacked batch operand
+        pts.append(SweepPoint(
+            "spmv:plus_times_f32", f"spmv/w{b}", "runtime",
+            (jnp.asarray(np.zeros((n, b)), jnp.float32),)))
+        pts.append(SweepPoint(
+            "spmv:plus_times_f32", f"spmv/w{b}", "warmup",
+            (jnp.asarray(np.zeros((n, b)), jnp.float32),)))
+    return pts
+
+
+def analyze_sweep(points: list[SweepPoint],
+                  expected: Optional[dict] = None,
+                  file: str = "", text: str = "") -> list[Finding]:
+    """Evaluate sweep points: per-group signature agreement, Python-
+    scalar leakage, and per-entry compile counts vs ``expected``."""
+    def ln(needle: str) -> int:
+        if text:
+            for i, l in enumerate(text.splitlines(), start=1):
+                if needle in l:
+                    return i
+        return 1
+
+    out: list[Finding] = []
+    by_group: dict[str, dict] = {}
+    by_entry: dict[str, set] = {}
+    for p in points:
+        sig = signature(p.args)
+        by_group.setdefault(p.group, {}).setdefault(sig, []).append(p)
+        by_entry.setdefault(p.entry, set()).add(sig)
+        leaks = py_scalar_leaves(p.args)
+        if leaks:
+            out.append(Finding(
+                core.RETRACE_PY_SCALAR, file or "<sweep>", ln(p.entry),
+                f"{p.group} ({p.origin}): raw Python scalar in traced "
+                f"position(s) {leaks} — weak-type cache key; wrap in "
+                f"jnp.asarray / jnp.int32", p.entry))
+
+    for group, sigs in sorted(by_group.items()):
+        if len(sigs) > 1:
+            detail = []
+            for sig, ps in sigs.items():
+                origins = ",".join(p.origin for p in ps)
+                detail.append(f"[{origins}] leaves={sig[1]}")
+            # name the drifting leaf kind when it is the weak-type bit
+            leafsets = [set(s[1]) for s in sigs]
+            weak = any(a[:2] == b[:2] and a[-1] != b[-1]
+                       for a in leafsets[0].union(*leafsets)
+                       for b in leafsets[0].union(*leafsets))
+            why = ("weak-type drift" if weak
+                   else "shape/dtype mismatch")
+            out.append(Finding(
+                core.RETRACE_DRIFT, file or "<sweep>",
+                ln(group.split("/")[0]),
+                f"plan-cache group {group} maps to {len(sigs)} distinct "
+                f"jit cache keys ({why}): " + "; ".join(sorted(detail)),
+                group.split("/")[0]))
+
+    if expected is not None:
+        for entry, sigs in sorted(by_entry.items()):
+            want = expected.get(entry)
+            if want is None:
+                out.append(Finding(
+                    core.RETRACE_EXTRA_COMPILE, file or "<sweep>", 1,
+                    f"entry {entry!r} has no committed expected compile "
+                    f"count (measured {len(sigs)}); add it to "
+                    f"retrace_serve.json", entry))
+            elif len(sigs) != want:
+                out.append(Finding(
+                    core.RETRACE_EXTRA_COMPILE, file or "<sweep>",
+                    ln(entry),
+                    f"entry {entry!r} compiles {len(sigs)} distinct "
+                    f"signatures over the ladder, committed expectation "
+                    f"is {want}", entry))
+    return out
+
+
+def run_retrace(expect_file=None) -> list[Finding]:
+    """The gate's retrace pass: serve sweep vs the committed
+    expectations artifact."""
+    path = pathlib.Path(expect_file or EXPECT_FILE)
+    text = path.read_text()
+    data = json.loads(text)
+    buckets = tuple(data.get("buckets") or ()) or None
+    expected = data.get("expected_compiles", {})
+    allow = set(data.get("allow", ()))
+    pts = build_serve_sweep(buckets=buckets)
+    findings = analyze_sweep(pts, expected, str(path), text)
+    return [f for f in findings if f.rule not in allow]
+
+
+# ---------------------------------------------------------------------------
+# empirical cross-check
+# ---------------------------------------------------------------------------
+
+def empirical_compile_count(fn: Callable, arg_sets: list[tuple]) -> int:
+    """Actually jit ``fn`` and count traces over ``arg_sets`` (each
+    cache miss re-enters the Python body). Executes — callers keep the
+    fixture tiny. Returns the number of traces; equal to the number of
+    distinct `signature()`s iff the static model is faithful."""
+    import jax
+    n = [0]
+
+    def counted(*args):
+        n[0] += 1
+        return fn(*args)
+
+    jitted = jax.jit(counted)
+    for args in arg_sets:
+        jax.block_until_ready(jitted(*args))
+    return n[0]
+
+
+def group_points(points: list[SweepPoint],
+                 entry: str) -> dict[str, list[SweepPoint]]:
+    out: dict[str, list[SweepPoint]] = {}
+    for p in points:
+        if p.entry == entry:
+            out.setdefault(p.group, []).append(p)
+    return out
+
+
+__all__ = ["SweepPoint", "signature", "leaf_signature",
+           "build_serve_sweep", "analyze_sweep", "run_retrace",
+           "empirical_compile_count", "group_points", "EXPECT_FILE"]

@@ -354,7 +354,13 @@ class GraphService:
         (cfg.bfs_bits, COMBBLAS_TPU_SERVE_BITS env) AND eligible
         (single-tile mesh, routed, verified pattern-symmetric —
         `models.bfs.bits_batch_ok`)."""
-        with self._plan_lock:
+        # Single-flight plan resolution: the tracing under this lock is
+        # intentional — it runs ONCE per service lifetime, before any
+        # worker dispatches, and serialization is the point (two threads
+        # racing plan_bfs is exactly the concurrent-collective shape
+        # that hung PR 4). Nothing else ever blocks on _plan_lock while
+        # holding another lock, so no ordering edge is created.
+        with self._plan_lock:  # analysis: allow(jit-under-lock)
             if not self._plans_resolved:
                 mode = self.cfg.bfs_bits
                 if os.environ.get("COMBBLAS_TPU_SERVE_BITS", "1") == "0":
@@ -438,7 +444,14 @@ class GraphService:
     def _labels_device(self):
         """Component labels, computed once for the service lifetime
         (the single amortized dispatch every CC lookup shares)."""
-        with self._cc_lock:
+        # Single-flight label build: fastsv under the lock is the
+        # cheapest correct design — the alternative (build outside,
+        # double-check inside) dispatches fastsv N times under a racing
+        # warmup. All callers reach here from the one worker thread or
+        # a warmup that runs before workers start; _cc_lock -> _stats
+        # lock (via _count_dispatch) is the only out-edge and _stats is
+        # a leaf lock.
+        with self._cc_lock:  # analysis: allow(jit-under-lock)
             if self._cc_labels is None:
                 labels = _cc.fastsv(self.a)
                 self._cc_labels = jnp.asarray(labels.to_global())

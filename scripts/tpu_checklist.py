@@ -4,6 +4,12 @@
     PYTHONPATH=/root/repo:/root/.axon_site python scripts/tpu_checklist.py
 
 Steps (each standalone, continues past failures):
+  0. (--analysis) static-analysis gate: run scripts/analyze.py in a
+     subprocess BEFORE burning chip time — budget overshoots, retrace
+     drift, and lock hazards are all catchable on CPU. The subprocess
+     matters: the gate forces the CPU backend and must not clobber
+     this process's TPU client. A failing gate aborts the checklist
+     (there is no point benchmarking a lowering that regressed).
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -11,6 +17,10 @@ Steps (each standalone, continues past failures):
   3. Phased SpGEMM A*A timing at scale 14/16.
 """
 
+import argparse
+import os
+import pathlib
+import subprocess
 import sys
 import time
 import traceback
@@ -20,7 +30,33 @@ def step(name):
     print(f"\n=== {name} ===", flush=True)
 
 
+def run_analysis_gate() -> bool:
+    """Step 0: the static gate, isolated in its own (CPU) process."""
+    step("0. static-analysis gate (CPU subprocess)")
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    # let analyze.py pick its own CPU backend even under the tunnel
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, str(repo / "scripts/analyze.py"),
+                        "--gate"], env=env)
+    if r.returncode != 0:
+        print("static-analysis gate FAILED — fix (or explicitly "
+              "suppress) the findings above before spending chip time",
+              flush=True)
+    return r.returncode == 0
+
+
 def main():
+    ap = argparse.ArgumentParser(
+        description="on-chip validation + perf checklist")
+    ap.add_argument("--analysis", action="store_true",
+                    help="run the static-analysis gate (scripts/"
+                         "analyze.py) before the on-chip steps; a "
+                         "failing gate aborts the checklist")
+    args = ap.parse_args()
+    if args.analysis and not run_analysis_gate():
+        sys.exit(1)
+
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -1,0 +1,35 @@
+"""Committed BAD pattern: bare .acquire() without try/finally.
+
+Lint fixture only — never imported. `leaky()` must fire
+`bare-acquire` (an exception in do_work leaks the lock forever);
+`clean()` must NOT fire (release in finally); `waived()` carries an
+explicit suppression and must be filtered out.
+"""
+
+import threading
+
+_lock = threading.Lock()
+
+
+def do_work():
+    raise RuntimeError("boom")
+
+
+def leaky():
+    _lock.acquire()
+    do_work()
+    _lock.release()
+
+
+def clean():
+    _lock.acquire()
+    try:
+        do_work()
+    finally:
+        _lock.release()
+
+
+def waived():
+    _lock.acquire()  # analysis: allow(bare-acquire)
+    do_work()
+    _lock.release()

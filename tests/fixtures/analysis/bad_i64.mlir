@@ -1,0 +1,17 @@
+// Committed BAD pattern: i64 DATA tensors in a lowering (x64 is off
+// everywhere in this repo; any i64 tensor doubles sort/route traffic).
+// The dense<...> attribute literal on the all_reduce is collective
+// METADATA and must NOT fire — only the convert's tensor<4xi64>
+// result (and its uses) count. Fed to budget.check_text by the
+// analyzer self-test.
+module @bad_i64 {
+  func.func public @main(%arg0: tensor<4xi32>) -> tensor<4xi64> {
+    %0 = "stablehlo.all_reduce"(%arg0) <{replica_groups = dense<0> : tensor<1x1xi64>, use_global_device_ids}> ({
+    ^bb0(%a: tensor<i32>, %b: tensor<i32>):
+      %s = stablehlo.add %a, %b : tensor<i32>
+      stablehlo.return %s : tensor<i32>
+    }) : (tensor<4xi32>) -> tensor<4xi32>
+    %1 = stablehlo.convert %0 : (tensor<4xi32>) -> tensor<4xi64>
+    return %1 : tensor<4xi64>
+  }
+}

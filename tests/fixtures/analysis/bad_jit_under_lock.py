@@ -1,0 +1,26 @@
+"""Committed BAD pattern: blocking jax dispatch under a held lock.
+
+Lint fixture only — never imported. This is the PR-4 hang shape: the
+stats path dispatches (and blocks on) device work while holding the
+lock the worker thread needs for its own collective; on the CPU mesh
+the two dispatches interleave and neither completes. The analyzer
+must report `jit-under-lock` on this file.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class Service:
+    def __init__(self, data):
+        self._lock = threading.Lock()
+        self._data = data
+        self._labels = None
+
+    def labels(self):
+        with self._lock:
+            if self._labels is None:
+                self._labels = jax.device_put(jnp.asarray(self._data))
+            return self._labels

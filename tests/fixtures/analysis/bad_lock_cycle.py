@@ -1,0 +1,26 @@
+"""Committed BAD pattern: AB/BA lock-ordering inversion.
+
+Lint fixture only — never imported by the package. Two methods take
+the same pair of locks in opposite orders; with two threads this
+deadlocks as soon as each grabs its first lock. The analyzer must
+report `lock-cycle` on this file (tests/test_analysis.py asserts it).
+"""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.total = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.total += 1
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.total -= 1

@@ -1,0 +1,223 @@
+"""Static-analysis gate (combblas_tpu.analysis): the three passes run
+clean on the merged tree, each rule demonstrably FIRES on its
+committed bad-pattern fixture under tests/fixtures/analysis/, and the
+retrace signature model agrees with jax's actual compile behavior.
+
+This module IS the CI wiring: `pytest -m quick` runs the same passes
+as `scripts/analyze.py --gate`, so a budget overshoot, an avoidable
+recompile, or a new lock hazard fails the quick suite directly.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import pytest
+
+from combblas_tpu import analysis
+from combblas_tpu.analysis import (budget, core, entries, hlo, lockorder,
+                                   retrace)
+
+pytestmark = pytest.mark.quick
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "analysis"
+
+
+def _fmt(findings):
+    return "\n".join(f.format() for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# clean tree: the gate passes on the merged state
+# ---------------------------------------------------------------------------
+
+def test_budget_pass_clean_on_tree():
+    fs = budget.run_budgets()
+    assert not fs, _fmt(fs)
+
+
+def test_retrace_pass_clean_on_tree():
+    fs = retrace.run_retrace()
+    assert not fs, _fmt(fs)
+
+
+def test_lockorder_pass_clean_on_tree():
+    fs = lockorder.run_lockorder()
+    assert not fs, _fmt(fs)
+
+
+def test_required_entry_points_registered():
+    # the ISSUE-mandated coverage: ESC pipeline, spmv/spmm, bits BFS
+    # core, and the bitseg/route multi-lane primitives
+    required = {"esc.spgemm", "esc.spgemm_2key", "esc.colwindow",
+                "spmv.plus_times_f32", "spmm.plus_times_f32",
+                "bfs.batch_dense", "bfs.bits_core",
+                "bitseg.multi", "route.multi"}
+    assert required <= set(entries.names())
+
+
+def test_every_budget_references_a_registered_entry():
+    for path in sorted(budget.BUDGET_DIR.glob("*.json")):
+        kernels, _ = budget.load_budget_file(path)
+        for kb in kernels:
+            entries.get(kb["entry"])    # raises on unknown
+
+
+# ---------------------------------------------------------------------------
+# the gate bites: committed bad-pattern fixtures
+# ---------------------------------------------------------------------------
+
+def test_budget_overshoot_fixture_fires():
+    fs = budget.run_budgets(files=[FIXTURES / "bad_budget_overshoot.json"])
+    rules = {f.rule for f in fs}
+    assert {core.SORT_COUNT, core.SORT_ARITY, core.OP_CEILING} <= rules, \
+        _fmt(fs)
+    # findings anchor to the violated number inside the budget file
+    for f in fs:
+        assert f.file.endswith("bad_budget_overshoot.json")
+        assert f.line > 1
+
+
+def test_i64_fixture_fires_but_attr_literals_exempt():
+    txt = (FIXTURES / "bad_i64.mlir").read_text()
+    fs = budget.check_text(txt, {"entry": "fixture.bad_i64",
+                                 "forbid_dtypes": ["i64"]}, "f")
+    assert {f.rule for f in fs} == {core.FORBID_DTYPE}, _fmt(fs)
+    # the all_reduce replica_groups dense literal alone must NOT count
+    attr = ('"stablehlo.all_reduce"(%x) <{replica_groups = '
+            "dense<0> : tensor<1x1xi64>}> : "
+            "(tensor<4xi32>) -> tensor<4xi32>")
+    assert hlo.find_dtype_tensors(attr, "i64") == []
+
+
+def test_retrace_expectation_fixture_fires():
+    fs = retrace.run_retrace(
+        expect_file=FIXTURES / "bad_retrace_expect.json")
+    assert core.RETRACE_EXTRA_COMPILE in {f.rule for f in fs}, _fmt(fs)
+    drifted = [f for f in fs if f.rule == core.RETRACE_EXTRA_COMPILE]
+    assert any("bfs-dense" in f.message for f in drifted)
+
+
+def test_retrace_drift_and_py_scalar_fire():
+    # warmup passes jnp.int32 but runtime leaks a raw Python int: one
+    # PlanCache slot, two jit cache keys — both rules must fire
+    pts = [retrace.SweepPoint("toy", "toy/w4", "runtime",
+                              (jnp.zeros((4,), jnp.int32), 7)),
+           retrace.SweepPoint("toy", "toy/w4", "warmup",
+                              (jnp.zeros((4,), jnp.int32), jnp.int32(1)))]
+    fs = retrace.analyze_sweep(pts)
+    rules = {f.rule for f in fs}
+    assert {core.RETRACE_DRIFT, core.RETRACE_PY_SCALAR} <= rules, _fmt(fs)
+
+
+def test_lock_cycle_fixture_fires():
+    fs = lockorder.run_lockorder(paths=[FIXTURES / "bad_lock_cycle.py"])
+    cyc = [f for f in fs if f.rule == core.LOCK_CYCLE]
+    assert cyc, _fmt(fs)
+    assert "Inverted._a" in cyc[0].message
+    assert "Inverted._b" in cyc[0].message
+
+
+def test_jit_under_lock_fixture_fires():
+    fs = lockorder.run_lockorder(
+        paths=[FIXTURES / "bad_jit_under_lock.py"])
+    hits = [f for f in fs if f.rule == core.JIT_UNDER_LOCK]
+    assert hits, _fmt(fs)
+    assert all(f.file.endswith("bad_jit_under_lock.py") for f in hits)
+
+
+def test_bare_acquire_fixture_fires_and_suppression_holds():
+    fs = lockorder.run_lockorder(
+        paths=[FIXTURES / "bad_bare_acquire.py"])
+    bares = [f for f in fs if f.rule == core.BARE_ACQUIRE]
+    # leaky() fires; clean() is try/finally-paired; waived() carries
+    # an explicit `# analysis: allow(bare-acquire)` and is filtered
+    assert len(bares) == 1, _fmt(fs)
+    src = (FIXTURES / "bad_bare_acquire.py").read_text().splitlines()
+    assert "def leaky" in src[bares[0].line - 2]
+
+
+def test_pr4_deadlock_shape_is_seen_and_deliberately_waived():
+    """Regression guard for the PR-4 hang: the lint must still SEE the
+    jit-dispatch-under-lock sites in serve/engine.py (the raw analyzer
+    reports them), and the merged tree must carry explicit, justified
+    suppressions (the filtered run is clean). Deleting either the
+    single-flight comment waiver or the lint's detection breaks this
+    test."""
+    engine = REPO / "combblas_tpu" / "serve" / "engine.py"
+    raw = lockorder.Analyzer([engine]).run()
+    raw_jit = [f for f, _ in raw if f.rule == core.JIT_UNDER_LOCK]
+    assert len(raw_jit) >= 3, _fmt(raw_jit)   # plan_bfs x2, fastsv, ...
+    assert not lockorder.run_lockorder(paths=[engine])
+
+
+# ---------------------------------------------------------------------------
+# suppression mechanics
+# ---------------------------------------------------------------------------
+
+def test_suppression_scope_lines():
+    src = ("with lock:  # analysis: allow(jit-under-lock)\n"
+           "    a = 1\n"
+           "    jax.device_put(a)\n")
+    sups = core.scan_suppressions(src)
+    assert sups == {1: {"jit-under-lock"}}
+    f = core.Finding(core.JIT_UNDER_LOCK, "f", 3, "m")
+    assert not core.is_suppressed(f, sups)              # own/prev line
+    assert core.is_suppressed(f, sups, scope_lines=(1,))  # with line
+    other = core.Finding(core.LOCK_CYCLE, "f", 3, "m")
+    assert not core.is_suppressed(other, sups, scope_lines=(1,))
+
+
+def test_budget_allow_list_waives():
+    kernels, _ = budget.load_budget_file(
+        FIXTURES / "bad_budget_overshoot.json")
+    kb = dict(kernels[0])
+    kb["allow"] = [core.SORT_COUNT, core.SORT_ARITY, core.OP_CEILING]
+    fs = budget.check_kernel(kb, "f")
+    assert not fs, _fmt(fs)
+
+
+# ---------------------------------------------------------------------------
+# the retrace signature model vs reality
+# ---------------------------------------------------------------------------
+
+def test_signature_model_matches_empirical_compiles():
+    """The static cache-key model must agree with jax: replay the cc
+    executor's sweep points (cheap gather) and count actual traces."""
+    pts = [p for p in retrace.build_serve_sweep(buckets=(1, 2), n=32)
+           if p.entry == "cc"]
+    assert len(pts) == 4
+    sigs = {retrace.signature(p.args) for p in pts}
+    traced = retrace.empirical_compile_count(
+        lambda labels, verts: labels[verts], [p.args for p in pts])
+    assert traced == len(sigs) == 2
+
+
+def test_bits_ladder_folds_to_one_signature():
+    # the headline serve property: lane alignment folds the whole
+    # bucket ladder into ONE bits executable
+    pts = [p for p in retrace.build_serve_sweep() if p.entry == "bfs-bits"]
+    assert len({retrace.signature(p.args) for p in pts}) == 1
+
+
+# ---------------------------------------------------------------------------
+# gate wiring
+# ---------------------------------------------------------------------------
+
+def test_run_all_selected_passes_clean():
+    assert analysis.run_all(passes=("retrace", "locks")) == []
+
+
+def test_cli_gate_exit_codes():
+    """`scripts/analyze.py --gate` is the CI contract: exit 0 on the
+    merged tree (cheap passes here; the budget pass is covered
+    in-process above), non-zero + file:line + rule id when a pass
+    finds violations (driven via the self-test fixtures)."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "analyze.py"),
+         "--gate", "--passes", "locks,retrace"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "PASS" in r.stdout
