@@ -28,19 +28,29 @@ BASELINE_GTEPS = 0.173
 
 
 def bench_bfs(args):
+    from combblas_tpu import obs
     from combblas_tpu.models import bfs as B
     from combblas_tpu.parallel.grid import ProcGrid
 
     grid = ProcGrid.make()
-    stats = B.graph500_run(grid, scale=args.scale,
-                           edgefactor=args.edgefactor,
-                           nroots=args.nroots,
-                           validate_roots=args.validate_roots,
-                           root_windows=args.root_windows,
-                           verbose=args.verbose)
+    # spans + ledger on: both only bracket perf_counter/record writes —
+    # no syncs enter the timed windows (see graph500_run's span note)
+    obs.reset()
+    obs.ledger.reset()
+    obs.set_enabled(True)
+    try:
+        stats = B.graph500_run(grid, scale=args.scale,
+                               edgefactor=args.edgefactor,
+                               nroots=args.nroots,
+                               validate_roots=args.validate_roots,
+                               root_windows=args.root_windows,
+                               verbose=args.verbose)
+    finally:
+        obs.set_enabled(False)
     s = stats.summary()
     s["window_times_s"] = [round(t, 4) for t in stats.window_times]
     s["window_sizes"] = stats.window_sizes
+    s["dispatch_summary"] = obs.dispatch_summary()
     return s
 
 
@@ -86,6 +96,7 @@ def bench_spgemm(args):
     # separate instrumented run for the span breakdown (syncs ON)
     obs.reset()
     obs.REGISTRY.reset()
+    obs.ledger.reset()
     obs.set_enabled(True)
     cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a,
                            phase_flop_budget=args.phase_flop_budget)
@@ -94,6 +105,7 @@ def bench_spgemm(args):
     breakdown = obs.export.phase_breakdown()
     spgemm_spans = obs.export.report()
     spgemm_metrics = obs.REGISTRY.snapshot()
+    spgemm_dispatches = obs.dispatch_summary()
     del cm
 
     # SpMSpV phase probe (untimed vs the metric; ~5% random fringe);
@@ -128,6 +140,7 @@ def bench_spgemm(args):
                                 for k, v in breakdown.items()},
             "unaccounted_s": round(breakdown["unaccounted"], 4),
             "spans": spgemm_spans, "metrics": spgemm_metrics,
+            "dispatch_summary": spgemm_dispatches,
             "spmsv_phases": spmsv_phases,
             "phases_note": "phase attribution requires a device sync "
                            "per phase; on a tunneled TPU each sync "
@@ -207,6 +220,7 @@ def bench_mcl(args):
     jax.block_until_ready(a.rows)
     obs.reset()
     obs.REGISTRY.reset()
+    obs.ledger.reset()
     obs.set_enabled(True)
     t0 = time.perf_counter()
     labels, nclusters, iters = M.mcl(
@@ -222,7 +236,8 @@ def bench_mcl(args):
                                 for k, v in breakdown.items()},
             "unaccounted_s": round(breakdown["unaccounted"], 4),
             "spans": obs.export.report(),
-            "metrics": obs.REGISTRY.snapshot()}
+            "metrics": obs.REGISTRY.snapshot(),
+            "dispatch_summary": obs.dispatch_summary()}
 
 
 def main():
@@ -335,6 +350,7 @@ def main():
                 "unaccounted_s": sp["unaccounted_s"],
                 "spans": sp["spans"],
                 "metrics": sp["metrics"],
+                "dispatch_summary": sp["dispatch_summary"],
                 "spmsv_phases": sp["spmsv_phases"],
                 "note": f"largest single-chip scale whose full C fits "
                         f"HBM is {sp['scale']} (baseline metric names "
@@ -362,7 +378,8 @@ def main():
                 **{k: mc[k] for k in ("n", "nnz", "planted_clusters",
                                       "found_clusters", "iterations",
                                       "phase_breakdown", "unaccounted_s",
-                                      "spans", "metrics")},
+                                      "spans", "metrics",
+                                      "dispatch_summary")},
             })
         except Exception as e:
             extra.append({"metric": "mcl_bench_error", "error": str(e)})
@@ -404,6 +421,7 @@ def main():
         "max_gteps": round(s["max_teps"] / 1e9, 4),
         "window_times_s": s["window_times_s"],
         "window_sizes": s["window_sizes"],
+        "dispatch_summary": s["dispatch_summary"],
         "timing": f"{s['n_windows']} timing windows; each window's "
                   "roots dispatched back-to-back with async stats "
                   "readback, wall time = [first dispatch, last "

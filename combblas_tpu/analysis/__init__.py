@@ -1,7 +1,7 @@
 """`combblas_tpu.analysis` — static-analysis gate for the repo's
 structural invariants.
 
-Three passes, one verdict (see `scripts/analyze.py --gate` and the
+Four passes, one verdict (see `scripts/analyze.py --gate` and the
 README "Static analysis" section):
 
 1. **Budget engine** (`budget.run_budgets`) — lowers registered
@@ -19,11 +19,16 @@ README "Static analysis" section):
    the package building the lock-acquisition graph: ordering cycles,
    blocking jit dispatch under a held lock (the PR-4 deadlock shape),
    bare `acquire()` without try/finally.
+4. **obs-residual budgets** (`obsbudget.run_obs`) — committed
+   ceilings over bench artifacts: `unaccounted_s` fraction of the
+   wall, dispatch counts at artifact paths (e.g. the bits-BFS
+   512-query burst), per-executable ledger counts, and required
+   instrumentation coverage (`ledger_names`).
 
-All passes are trace/AST only — nothing here compiles or executes
-device code — and every finding carries `file:line`, a rule id, and a
-suppression syntax (`# analysis: allow(<rule>)` in source, `"allow"`
-lists in the JSON budgets).
+All passes are trace/AST/JSON only — nothing here compiles or
+executes device code — and every finding carries `file:line`, a rule
+id, and a suppression syntax (`# analysis: allow(<rule>)` in source,
+`"allow"` lists in the JSON budgets).
 """
 
 from __future__ import annotations
@@ -48,7 +53,13 @@ def run_lockorder(**kw):
     return lockorder.run_lockorder(**kw)
 
 
-def run_all(passes=("budgets", "retrace", "locks")) -> list[Finding]:
+def run_obs(**kw):
+    from combblas_tpu.analysis import obsbudget
+    return obsbudget.run_obs(**kw)
+
+
+def run_all(passes=("budgets", "retrace", "locks", "obs")) \
+        -> list[Finding]:
     """Run the selected passes; returns all unsuppressed findings
     (empty = gate passes)."""
     out: list[Finding] = []
@@ -58,4 +69,6 @@ def run_all(passes=("budgets", "retrace", "locks")) -> list[Finding]:
         out += run_retrace()
     if "locks" in passes:
         out += run_lockorder()
+    if "obs" in passes:
+        out += run_obs()
     return out

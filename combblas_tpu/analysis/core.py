@@ -42,10 +42,17 @@ LOCK_CYCLE = "lock-cycle"            # ordering cycle in the lock graph
 JIT_UNDER_LOCK = "jit-under-lock"    # blocking jax dispatch while a lock is held
 BARE_ACQUIRE = "bare-acquire"        # .acquire() without try/finally release
 
+# obs-residual budget pass (pass 4)
+OBS_RESIDUAL = "obs-residual"            # unaccounted_s fraction over ceiling
+OBS_DISPATCH_COUNT = "obs-dispatch-count"  # dispatch count over ceiling
+OBS_STALE = "obs-stale-artifact"         # budget names an artifact/path/
+#                                          executable that no longer exists
+
 ALL_RULES = (
     SORT_COUNT, SORT_ARITY, OP_CEILING, FORBID_DTYPE, FORBID_OP,
     LANE_INVARIANCE, RETRACE_DRIFT, RETRACE_PY_SCALAR,
     RETRACE_EXTRA_COMPILE, LOCK_CYCLE, JIT_UNDER_LOCK, BARE_ACQUIRE,
+    OBS_RESIDUAL, OBS_DISPATCH_COUNT, OBS_STALE,
 )
 
 

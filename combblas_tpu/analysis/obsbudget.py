@@ -1,0 +1,255 @@
+"""Pass 4 — obs-residual budget pass over committed bench artifacts.
+
+The flight recorder (`combblas_tpu.obs.ledger`) turned "63% of the MCL
+expansion wall is unaccounted" from a mystery into a named executable
+table. This pass commits that progress: declarative ceilings in
+`analysis/budgets/obs_*.json` pin, per driver artifact,
+
+* the `unaccounted_s` FRACTION of the total wall (the span residual no
+  categorized span claimed) — regressions in attribution coverage or
+  in dispatch glue fail the gate, not a future bench reader;
+* dispatch COUNTS at committed artifact paths (e.g. the bits-BFS
+  512-query burst's `serve_bits.dispatches`) — the serving layer's
+  whole point is dispatch amortization, so a count creep is a perf
+  bug even when wall clock hides it;
+* per-executable call counts and required executable names from the
+  artifact's `dispatch_summary` ledger block — a committed ledger
+  expectation that stops matching (executable renamed, wrapper
+  dropped) is flagged as STALE rather than silently vacuous.
+
+Budget JSON shape (one file may pin several artifacts)::
+
+    {"artifacts": [{
+        "artifact": "SERVE_BENCH.json",     # repo-root relative; "*"
+                                            # globs pick newest by mtime
+                                            # (bench.py's embed rule)
+        "driver": "serve",
+        "unaccounted": {"path": "unaccounted_s", "total_path": "value",
+                        "frac_max": 0.15, "missing_ok": true},
+        "dispatch_ceilings": {"open_loop.dispatches": 20},
+        "executable_ceilings": {"bfs.batch": 64},   # max ledger count
+        "ledger_names": ["serve.bfs"],      # must appear (prefix match:
+                                            # "serve.bfs" covers
+                                            # "serve.bfs/w32")
+        "require_dispatch_summary": false,  # tolerate TPU-era artifacts
+                                            # recorded before the ledger
+        "allow": []                         # waived rule ids
+    }]}
+
+All checks are pure JSON reads — nothing here compiles or runs device
+code. Ceilings are maxima (dropping below is improvement); the STALE
+rule is the only bidirectional one, by design: it exists to keep the
+committed expectations honest.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+
+BUDGET_DIR = pathlib.Path(__file__).parent / "budgets"
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def _get_path(doc, dotted: str):
+    """(value, found) of a dotted path into nested dicts."""
+    cur = doc
+    for part in dotted.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return None, False
+    return cur, True
+
+
+def _load_artifact(path: pathlib.Path):
+    """Artifact JSON: whole file, else the LAST parseable line (bench
+    scripts emit JSON-lines with the headline last)."""
+    text = path.read_text()
+    try:
+        return json.loads(text)
+    except ValueError:
+        pass
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                return json.loads(ln)
+            except ValueError:
+                continue
+    raise ValueError(f"{path}: no parseable JSON object")
+
+
+def _collect_summaries(doc, out=None) -> list:
+    """All `dispatch_summary` blocks anywhere in the artifact (serve
+    artifacts nest one per mode)."""
+    if out is None:
+        out = []
+    if isinstance(doc, dict):
+        ds = doc.get("dispatch_summary")
+        if isinstance(ds, dict):
+            out.append(ds)
+        for v in doc.values():
+            _collect_summaries(v, out)
+    elif isinstance(doc, list):
+        for v in doc:
+            _collect_summaries(v, out)
+    return out
+
+
+def _exec_counts(summaries: list) -> dict:
+    """executable name -> max recorded count across summaries."""
+    counts: dict = {}
+    for ds in summaries:
+        for row in ds.get("top", []):
+            name = row.get("name")
+            if name:
+                counts[name] = max(counts.get(name, 0),
+                                   int(row.get("count", 0)))
+    return counts
+
+
+def _name_covered(want: str, names) -> bool:
+    """Exact match, or prefix match at a path boundary ("serve.bfs"
+    covers "serve.bfs/w32" and "serve.bfs.l32/w64")."""
+    for n in names:
+        if n == want or n.startswith(want + "/") or \
+                n.startswith(want + "."):
+            return True
+    return False
+
+
+def _line_of(text: str, anchor: str, key: str) -> int:
+    """Line of ``key`` inside the budget block containing ``anchor``
+    (same convention as budget._line_of: findings point at the violated
+    number)."""
+    lines = text.splitlines()
+    start = 0
+    for i, ln in enumerate(lines):
+        if anchor in ln:
+            start = i
+            break
+    for i in range(start, len(lines)):
+        if f'"{key}"' in lines[i]:
+            return i + 1
+    return start + 1
+
+
+def _resolve_artifact(name: str, root: pathlib.Path):
+    """Artifact path; globs resolve to the newest match by mtime (the
+    same rule bench.py uses to embed MCL_BENCH_*.json)."""
+    if any(ch in name for ch in "*?["):
+        cands = sorted(root.glob(name),
+                       key=lambda p: (p.stat().st_mtime, p.name))
+        return cands[-1] if cands else None
+    p = root / name
+    return p if p.exists() else None
+
+
+def check_artifact(ent: dict, budget_text: str, budget_path: str,
+                   root=None) -> list[Finding]:
+    """All findings for one budget entry (the unit the self-test
+    fixtures drive)."""
+    allow = set(ent.get("allow", []))
+    name = ent["artifact"]
+    driver = ent.get("driver", name)
+    findings: list[Finding] = []
+
+    def add(rule, key, msg):
+        if rule not in allow:
+            findings.append(Finding(
+                rule, budget_path, _line_of(budget_text, name, key),
+                msg, entry=driver))
+
+    path = _resolve_artifact(name, pathlib.Path(root or REPO_ROOT))
+    if path is None:
+        add(core.OBS_STALE, "artifact",
+            f"artifact {name!r} not found — the committed obs budget "
+            "is stale")
+        return findings
+    try:
+        art = _load_artifact(path)
+    except ValueError as e:
+        add(core.OBS_STALE, "artifact", f"artifact unreadable: {e}")
+        return findings
+
+    u = ent.get("unaccounted")
+    if u:
+        val, ok1 = _get_path(art, u.get("path", "unaccounted_s"))
+        tot, ok2 = _get_path(art, u.get("total_path", "value"))
+        if not (ok1 and ok2):
+            if not u.get("missing_ok", False):
+                add(core.OBS_STALE, "unaccounted",
+                    f"{path.name}: no {u.get('path')!r}/"
+                    f"{u.get('total_path')!r} fields — rerun the bench "
+                    "with the obs recorder on, or mark missing_ok")
+        elif tot and float(val) / float(tot) > float(u["frac_max"]):
+            add(core.OBS_RESIDUAL, "frac_max",
+                f"{path.name}: unaccounted {float(val):.4g}s is "
+                f"{float(val) / float(tot):.1%} of {float(tot):.4g}s "
+                f"total (ceiling {float(u['frac_max']):.0%}) — the "
+                "residual grew; see the ledger top-K table for where")
+
+    for dotted, ceil in (ent.get("dispatch_ceilings") or {}).items():
+        v, ok = _get_path(art, dotted)
+        if not ok:
+            add(core.OBS_STALE, dotted.rsplit(".", 1)[-1],
+                f"{path.name}: committed count path {dotted!r} missing "
+                "— the artifact shape drifted from the budget")
+        elif int(v) > int(ceil):
+            add(core.OBS_DISPATCH_COUNT, dotted.rsplit(".", 1)[-1],
+                f"{path.name}: {dotted} = {int(v)} exceeds the "
+                f"committed ceiling {int(ceil)} — dispatch count crept "
+                "(batching/fusion regression)")
+
+    summaries = _collect_summaries(art)
+    wants_ledger = (ent.get("executable_ceilings")
+                    or ent.get("ledger_names")
+                    or ent.get("require_dispatch_summary"))
+    if not summaries:
+        if ent.get("require_dispatch_summary"):
+            add(core.OBS_STALE, "require_dispatch_summary",
+                f"{path.name}: no dispatch_summary block — rerun the "
+                "bench with the dispatch ledger on")
+        return findings
+    if not wants_ledger:
+        return findings
+    counts = _exec_counts(summaries)
+    for ex, ceil in (ent.get("executable_ceilings") or {}).items():
+        if ex not in counts:
+            add(core.OBS_STALE, ex,
+                f"{path.name}: ledger expectation {ex!r} matched no "
+                "recorded executable — the wrapper was renamed or "
+                "dropped; update the budget")
+        elif counts[ex] > int(ceil):
+            add(core.OBS_DISPATCH_COUNT, ex,
+                f"{path.name}: executable {ex!r} dispatched "
+                f"{counts[ex]}x (ceiling {int(ceil)})")
+    for want in ent.get("ledger_names") or []:
+        if not _name_covered(want, counts):
+            add(core.OBS_STALE, "ledger_names",
+                f"{path.name}: required executable {want!r} absent "
+                f"from the dispatch ledger (recorded: "
+                f"{sorted(counts)[:8]}...) — instrumentation coverage "
+                "regressed or the name changed")
+    return findings
+
+
+def run_obs(files=None, root=None) -> list[Finding]:
+    """Run the obs-residual budget pass over the committed budgets (or
+    an explicit fixture list); returns unsuppressed findings."""
+    paths = ([pathlib.Path(f) for f in files] if files is not None
+             else sorted(BUDGET_DIR.glob("obs_*.json")))
+    findings: list[Finding] = []
+    for p in paths:
+        text = p.read_text()
+        data = json.loads(text)
+        for ent in data.get("artifacts", []):
+            if "artifact" not in ent:
+                raise ValueError(f"{p}: obs budget entry without "
+                                 "'artifact'")
+            findings += check_artifact(ent, text, str(p), root=root)
+    return findings

@@ -1109,6 +1109,21 @@ def _bfs_batch_bits_core(a: dm.DistSpMat, plan: BfsPlan, roots, ml):
             lanelvl, done)
 
 
+# flight-recorder boundaries (ledger.instrument): eager calls of the
+# per-root / batched traversal drivers record one DispatchRecord each;
+# in-trace composition (bfs_bits inside bfs_bits_mesh, plan_bfs inside
+# bfs) passes through untouched. Async on purpose — the g500 harness
+# overlaps dispatch with the stats drain, and the drain's readback
+# records carry the device wall.
+bfs = obs.instrument(bfs, "bfs.bfs")
+bfs_batch = obs.instrument(bfs_batch, "bfs.batch")
+bfs_bits = obs.instrument(bfs_bits, "bfs.bits")
+_bfs_batch_bits_core = obs.instrument(_bfs_batch_bits_core,
+                                      "bfs.batch_bits")
+_plan_bfs_core = obs.instrument(_plan_bfs_core, "bfs.plan_core",
+                                sync=True)
+
+
 def _bits_mesh_ok(a: dm.DistSpMat, plan: BfsPlan) -> bool:
     """Whether the distributed edge-space bit BFS applies: routed plan
     with col-run bits, square mesh (the packed vertex-bit transpose
@@ -1274,6 +1289,9 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     return dv.DistVec(parents, grid, ROW_AXIS, a.nrows)
 
 
+bfs_bits_mesh = obs.instrument(bfs_bits_mesh, "bfs.bits_mesh")
+
+
 @jax.jit
 def row_degrees(a: dm.DistSpMat) -> jax.Array:
     """(pr, tile_m) int32 per-row degree of the (deduplicated) matrix,
@@ -1433,6 +1451,7 @@ class BfsRunStats:
         }
 
 
+@obs.traced("graph500_run")
 def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
                  nroots: int = 16, seed: int = 1, cap_slack: float = 0.98,
                  validate: bool = False, validate_roots: int = 0,
@@ -1484,7 +1503,8 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
     roots: list[int] = []
     for _attempt in range(64):
         cand = rng_np.choice(n, size=min(n, 4 * nroots), replace=False)
-        dvals = np.asarray(deg.reshape(-1)[jnp.asarray(cand)])
+        with obs.ledger.readback("bfs.degree_readback", 4 * len(cand)):
+            dvals = np.asarray(deg.reshape(-1)[jnp.asarray(cand)])
         for v, dv_ in zip(cand, dvals):
             if dv_ > 0 and int(v) not in roots:
                 roots.append(int(v))
@@ -1552,6 +1572,11 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
         visited_d, nedges_d = run_stats(deg_, parents)
         return parents, jnp.stack([visited_d, nedges_d])
 
+    # the one executable the timed windows actually dispatch; async so
+    # windows keep their overlap (the drain records the arrival wall)
+    run_with_stats = obs.ledger.instrument(run_with_stats,
+                                           "bfs.run_with_stats")
+
     # warm-up compile (not timed, like the reference's untimed iteration 0)
     with obs.span("g500_warmup", category="compile"):
         _ = np.asarray(run_with_stats(a, plan, deg, jnp.int32(roots[0]))[1])
@@ -1599,7 +1624,8 @@ def graph500_run(grid: ProcGrid, scale: int, edgefactor: int = 16,
             with obs.span("drain", category="host_readback"):
                 while queue:
                     ri, kp, vn = queue.pop(0)
-                    vnv = np.asarray(vn)            # waits for arrival
+                    with obs.ledger.readback("bfs.stats_readback", 8):
+                        vnv = np.asarray(vn)        # waits for arrival
                     per_root.append((ri, int(vnv[0]), int(vnv[1])))
                     if kp is not None:
                         vparents[ri] = kp

@@ -275,6 +275,15 @@ def _fastsv_sharded(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
     return dvec.DistVec(f, grid, ROW_AXIS, n)
 
 
+# flight-recorder boundaries: eager driver calls (fastsv dispatches
+# one of these; serve's label build goes through fastsv) land in the
+# dispatch ledger; in-trace calls pass straight through
+_fastsv_replicated = obs.instrument(
+    _fastsv_replicated, "cc.fastsv_replicated", sync=True)
+_fastsv_sharded = obs.instrument(
+    _fastsv_sharded, "cc.fastsv_sharded", sync=True)
+
+
 @partial(jax.jit, static_argnames=("max_iters",))
 def lacc(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
     """Component labels by Awerbuch-Shiloach-style star hooking
@@ -350,10 +359,14 @@ def lacc(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
     return dvec.DistVec(data.reshape(grid.pr, tile_m), grid, ROW_AXIS, n)
 
 
+lacc = obs.instrument(lacc, "cc.lacc", sync=True)
+
+
 def label_cc(labels: dvec.DistVec) -> tuple[dvec.DistVec, int]:
     """Relabel component roots to contiguous 0..ncomp-1 ids
     (≅ LabelCC, FastSV.h:56). Host-side (app driver boundary)."""
-    lg = np.asarray(labels.to_global())
+    with obs.ledger.readback("cc.labels_readback", 4 * labels.glen):
+        lg = np.asarray(labels.to_global())
     uniq, inv = np.unique(lg, return_inverse=True)
     out = dvec.from_global(labels.grid, labels.axis,
                            jnp.asarray(inv.astype(np.int32)))

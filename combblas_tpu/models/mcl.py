@@ -95,12 +95,24 @@ def make_col_stochastic(a: dm.DistSpMat) -> dm.DistSpMat:
     return alg.dim_apply(a, "col", sums.map(_inv_or_zero), _times)
 
 
+# flight-recorder boundary: eager driver calls land in the dispatch
+# ledger (sync=True so wall_s includes device wall); calls traced
+# inside another jit (e.g. from `inflate`) pass straight through
+make_col_stochastic = obs.instrument(
+    make_col_stochastic, "mcl.make_col_stochastic", sync=True)
+
+
 @jax.jit
 def _chaos_dev(a: dm.DistSpMat):
     colmax = alg.reduce(S.MAX, a, "col")
     colssq = alg.reduce(S.PLUS, a, "col", map_val=jnp.square)
     d = jnp.where(colmax.data > -jnp.inf, colmax.data - colssq.data, 0.0)
     return jnp.max(d)
+
+
+_chaos_dev = obs.instrument(_chaos_dev, "mcl.chaos_dev", sync=True)
+
+_repin = obs.instrument(dm.with_capacity, "mcl.repin", sync=True)
 
 
 def chaos(a: dm.DistSpMat) -> float:
@@ -125,6 +137,9 @@ def inflate(a: dm.DistSpMat, power: float) -> dm.DistSpMat:
 
 def _pow(v, power):
     return jnp.power(v, power)
+
+
+inflate = obs.instrument(inflate, "mcl.inflate", sync=True)
 
 
 @partial(jax.jit, static_argnames=("p",))
@@ -154,6 +169,10 @@ def mcl_prune_select_recover(c: dm.DistSpMat, p: MclParams) -> dm.DistSpMat:
     thr2 = dv.ewise_apply(need, dv.ewise_apply(rec_thr, thr, _pack2),
                           _select_thr)
     return alg.prune_column(c, thr2, _lt)
+
+
+mcl_prune_select_recover = obs.instrument(
+    mcl_prune_select_recover, "mcl.prune_select_recover", sync=True)
 
 
 def _floor_thr(v, floor):
@@ -231,12 +250,13 @@ def _mcl_instrumented(a, params, verbose, cap_ladder=None):
                 # one host readback per iteration; the first (largest)
                 # iteration usually sets the bucket — MCL's nnz shrinks
                 # after pruning — but a later growth simply re-pins
-                with obs.span("cap_readback", category="host_readback"):
+                with obs.span("cap_readback", category="host_readback"), \
+                        obs.ledger.readback("mcl.cap_readback", 4):
                     mx = int(np.asarray(a.nnz).max())
                 if cap_pin is None or mx > cap_pin:
                     cap_pin = -(-(mx * 5 // 4) // 128) * 128
                 with obs.span("repin", category="device_execute"):
-                    a = dm.with_capacity(a, cap_pin)
+                    a = _repin(a, cap_pin)
                     obs.sync(a.vals)
                 _M_NNZ.set(mx)
             else:

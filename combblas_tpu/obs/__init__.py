@@ -27,12 +27,17 @@ Quick start::
     obs.export.chrome_trace("trace.json")    # open in ui.perfetto.dev
 """
 
-from combblas_tpu.obs import export, metrics, trace
+from combblas_tpu.obs import export, httpd, ledger, metrics, timeline, trace
 from combblas_tpu.obs.trace import (
-    CATEGORIES, TRACER, Tracer, enabled, reset, set_enabled, span, sync,
+    CATEGORIES, TRACER, Tracer, current_path, enabled, get_trace_id,
+    new_trace_id, reset, set_enabled, set_trace_id, span, sync, traced,
 )
 from combblas_tpu.obs.metrics import REGISTRY, counter, gauge, histogram
 from combblas_tpu.obs.export import (
-    chrome_trace, format_report, phase_breakdown, profiler_trace, report,
-    read_jsonl, read_jsonl_metrics, to_jsonl,
+    chrome_trace, dispatch_summary, format_report, phase_breakdown,
+    profiler_trace, report, read_jsonl, read_jsonl_metrics, to_jsonl,
+)
+from combblas_tpu.obs.ledger import LEDGER, Ledger, instrument
+from combblas_tpu.obs.httpd import (
+    MetricsServer, parse_prometheus, prometheus_text, serve_metrics,
 )

@@ -51,6 +51,24 @@ def unaccounted_s(tracer: Tracer | None = None) -> float:
     return phase_breakdown(tracer)[UNACCOUNTED]
 
 
+def dispatch_summary(k: int = 10, ledger=None) -> dict:
+    """The BENCH-artifact block next to `phase_breakdown`: top-K
+    executables by total wall from the dispatch ledger, plus totals.
+    {top: [{name, count, total_s, mean_s, compiles, ...}], dispatches,
+    readbacks, compiles, recorded, dropped}."""
+    from combblas_tpu.obs import ledger as _ledger
+    led = ledger if ledger is not None else _ledger.LEDGER
+    recs = led.snapshot()
+    return {
+        "top": _ledger.top_k(k, by="wall", records=recs),
+        "dispatches": sum(1 for r in recs if r.kind == "dispatch"),
+        "readbacks": sum(1 for r in recs if r.kind == "readback"),
+        "compiles": sum(1 for r in recs if r.compiled),
+        "recorded": led.total,
+        "dropped": led.dropped,
+    }
+
+
 # ---------------------------------------------------------------------------
 # Human report tree (self/total per span path)
 # ---------------------------------------------------------------------------
@@ -191,12 +209,23 @@ def read_jsonl_metrics(path) -> dict | None:
 # Chrome trace (chrome://tracing / https://ui.perfetto.dev)
 # ---------------------------------------------------------------------------
 
-def chrome_trace(path, tracer: Tracer | None = None) -> int:
+def chrome_trace(path, tracer: Tracer | None = None,
+                 include_ledger: bool = True) -> int:
     """Emit complete ("ph": "X") events, microsecond timestamps
     rebased to the earliest span. Category and attrs land in `args`;
-    `cat` enables Perfetto's category filter."""
+    `cat` enables Perfetto's category filter.
+
+    Ledger dispatches ride along as X events on a synthetic
+    `pid=1` "dispatch" track, and every record carrying a trace id
+    additionally emits async FLOW events ("b"/"e" with `id` = the
+    trace id) so one request's dispatches link across threads in
+    Perfetto's flow view."""
     recs = _records(tracer)
-    t_base = min((r.t0 for r in recs), default=0.0)
+    led_recs = []
+    if include_ledger:
+        from combblas_tpu.obs import ledger as _ledger
+        led_recs = _ledger.LEDGER.snapshot()
+    t_base = min((r.t0 for r in recs + led_recs), default=0.0)
     events = [{
         "name": r.name,
         "cat": r.category or "other",
@@ -208,6 +237,31 @@ def chrome_trace(path, tracer: Tracer | None = None) -> int:
         "args": {"path": "/".join(r.path), "self_s": round(r.self_s, 6),
                  **r.attrs},
     } for r in recs]
+    for r in led_recs:
+        base = {
+            "name": r.name,
+            "cat": f"ledger_{r.kind}",
+            "ts": (r.t0 - t_base) * 1e6,
+            "pid": 1,
+            "tid": r.tid % 2 ** 31,
+            "args": {"seq": r.seq, "path": "/".join(r.path),
+                     "arg_bytes": r.arg_bytes, "out_bytes": r.out_bytes,
+                     "compiled": r.compiled,
+                     "trace_id": r.trace_id or ""},
+        }
+        events.append({**base, "ph": "X", "dur": r.wall_s * 1e6})
+        if r.trace_id:
+            # async begin/end pair: Perfetto draws a flow arrow per
+            # trace id spanning every dispatch that carried it
+            try:
+                fid = int(r.trace_id.lstrip("t"), 16) & 0x7FFFFFFF
+            except ValueError:      # externally-minted id: any string
+                fid = hash(r.trace_id) & 0x7FFFFFFF
+            events.append({**base, "ph": "b", "id": fid,
+                           "cat": "request"})
+            events.append({**base, "ph": "e", "id": fid,
+                           "cat": "request",
+                           "ts": (r.t0 + r.wall_s - t_base) * 1e6})
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)

@@ -1,0 +1,271 @@
+"""Live metrics endpoint: stdlib-HTTP `/metrics` (Prometheus text
+exposition), `/varz` (full JSON snapshot), `/healthz`.
+
+Serving-side observability must be scrapeable while the service is
+under load, and must stay OFF the dispatch path: the endpoint runs on
+its own daemon thread (stdlib `ThreadingHTTPServer`, port 0 picks a
+free port), and every handler only READS — registry snapshots copy
+under per-metric locks, ledger snapshots are lock-free reads — so a
+scrape never blocks a worker and never touches a device.
+
+`prometheus_text` / `parse_prometheus` are pure functions so tests can
+verify the exposition format round-trips without sockets.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import re
+import threading
+import time
+
+from combblas_tpu.obs import ledger as _ledger
+from combblas_tpu.obs import metrics as _metrics
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: quantile key ("p50") -> Prometheus quantile label value ("0.5")
+_Q_LABEL = {"p50": "0.5", "p90": "0.9", "p99": "0.99"}
+
+
+def _san(name: str) -> str:
+    """Metric-name sanitizer: dots (our namespacing) -> underscores,
+    anything else invalid -> underscore."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not _NAME_OK.match(out):
+        out = "_" + out
+    return out
+
+
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+                 .replace("\n", "\\n")
+
+
+def _labels(d: dict, extra: dict | None = None) -> str:
+    items = dict(d)
+    if extra:
+        items.update(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{_san(str(k))}="{_esc(v)}"'
+                    for k, v in sorted(items.items()))
+    return "{" + body + "}"
+
+
+def _num(v) -> str:
+    if v is None:
+        return "NaN"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(snapshot: dict | None = None) -> str:
+    """Render a `REGISTRY.snapshot()`-shaped dict as Prometheus text
+    exposition format (version 0.0.4). Histograms emit the standard
+    `_bucket`/`_sum`/`_count` family plus a SEPARATE `<name>_quantile`
+    gauge family carrying the p50/p90/p99 estimates (reservoir or P²
+    sketch, whichever the metric uses) — quantiles on a histogram
+    family itself would be invalid exposition."""
+    snap = snapshot if snapshot is not None else \
+        _metrics.REGISTRY.snapshot()
+    out = []
+    for name in sorted(snap):
+        m = snap[name]
+        pname = _san(name)
+        help_txt = m.get("help") or name
+        mtype = m["type"]
+        out.append(f"# HELP {pname} {_esc(help_txt)}")
+        out.append(f"# TYPE {pname} {mtype}")
+        if mtype in ("counter", "gauge"):
+            for s in m["series"]:
+                out.append(f"{pname}{_labels(s['labels'])} "
+                           f"{_num(s['value'])}")
+            continue
+        # histogram: cumulative buckets + sum/count
+        qlines = []
+        for s in m["series"]:
+            lbl = s["labels"]
+            for bound, cum in zip(s["bounds"], s["buckets"]):
+                out.append(f"{pname}_bucket"
+                           f"{_labels(lbl, {'le': _num(bound)})} {cum}")
+            out.append(f"{pname}_bucket{_labels(lbl, {'le': '+Inf'})} "
+                       f"{s['count']}")
+            out.append(f"{pname}_sum{_labels(lbl)} {_num(s['sum'])}")
+            out.append(f"{pname}_count{_labels(lbl)} {s['count']}")
+            for q, qv in _Q_LABEL.items():
+                if s.get(q) is not None:
+                    qlines.append(
+                        f"{pname}_quantile"
+                        f"{_labels(lbl, {'quantile': qv})} "
+                        f"{_num(s[q])}")
+        if qlines:
+            out.append(f"# HELP {pname}_quantile "
+                       f"{_esc(help_txt)} (streaming quantiles)")
+            out.append(f"# TYPE {pname}_quantile gauge")
+            out.extend(qlines)
+    return "\n".join(out) + "\n"
+
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
+_LABEL_PAIR = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus(text: str) -> dict:
+    """Strict-enough parser for tests: validates every line is a
+    well-formed comment or sample, every sample's family has a # TYPE,
+    and no duplicate series. Returns {(name, labels_tuple): value}."""
+    typed = {}
+    series = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or parts[1] not in ("HELP", "TYPE"):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            if not _NAME_OK.match(parts[2]):
+                raise ValueError(f"line {lineno}: bad metric name "
+                                 f"{parts[2]!r}")
+            if parts[1] == "TYPE":
+                if len(parts) != 4 or parts[3] not in (
+                        "counter", "gauge", "histogram", "summary",
+                        "untyped"):
+                    raise ValueError(f"line {lineno}: bad TYPE {line!r}")
+                typed[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        name = m.group("name")
+        base = name
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[: -len(suf)] in typed:
+                base = name[: -len(suf)]
+                break
+        if base not in typed:
+            raise ValueError(f"line {lineno}: sample {name!r} has no "
+                             f"# TYPE declaration")
+        raw = m.group("labels") or ""
+        labels = tuple(sorted((k, v.replace('\\"', '"')
+                               .replace("\\n", "\n")
+                               .replace("\\\\", "\\"))
+                              for k, v in _LABEL_PAIR.findall(raw)))
+        consumed = sum(len(k) + len(v) + 4 for k, v in
+                       _LABEL_PAIR.findall(raw))
+        if raw and consumed < len(raw.rstrip(",")):
+            raise ValueError(f"line {lineno}: bad labels {raw!r}")
+        key = (name, labels)
+        if key in series:
+            raise ValueError(f"line {lineno}: duplicate series {key}")
+        val = m.group("value")
+        series[key] = float("nan") if val == "NaN" else float(val)
+    return series
+
+
+def varz_snapshot(extra=None, top_k: int = 10) -> dict:
+    """JSON-ready full snapshot: metrics registry + ledger top-K +
+    whatever the hosting service adds via `extra()` (e.g. GraphService
+    stats/plan-cache hit rates)."""
+    led = _ledger.LEDGER
+    out = {
+        "ts": time.time(),
+        "metrics": _metrics.REGISTRY.snapshot(),
+        "ledger": {
+            "total": led.total,
+            "dropped": led.dropped,
+            "capacity": led.capacity,
+            "top": _ledger.top_k(top_k),
+            "instrumented": sorted(_ledger.INSTRUMENTED),
+        },
+    }
+    if extra is not None:
+        try:
+            out["service"] = extra()
+        except Exception as e:          # scrape must not 500 on a race
+            out["service"] = {"error": repr(e)}
+    return out
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "combblas-obs/1"
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):      # noqa: N802 (stdlib API name)
+        path = self.path.split("?", 1)[0]
+        try:
+            if path == "/healthz":
+                extra = self.server.varz_fn
+                healthy = True
+                if extra is not None:
+                    svc = varz_snapshot(extra).get("service", {})
+                    healthy = bool(svc.get("healthy", True)) \
+                        if isinstance(svc, dict) else True
+                self._send(200 if healthy else 503,
+                           b"ok\n" if healthy else b"unhealthy\n",
+                           "text/plain; charset=utf-8")
+            elif path == "/metrics":
+                body = prometheus_text().encode()
+                self._send(200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/varz":
+                body = json.dumps(varz_snapshot(self.server.varz_fn),
+                                  indent=1, default=str).encode()
+                self._send(200, body, "application/json")
+            else:
+                self._send(404, b"not found\n",
+                           "text/plain; charset=utf-8")
+        except BrokenPipeError:          # scraper went away mid-write
+            pass
+
+    def log_message(self, *a):           # keep worker stdout clean
+        pass
+
+
+class MetricsServer:
+    """Daemon-thread HTTP server exposing /metrics, /varz, /healthz.
+
+    `varz` is an optional zero-arg callable returning a JSON-ready dict
+    merged into /varz under "service" (and consulted for a "healthy"
+    key by /healthz)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 varz=None):
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.varz_fn = varz
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-httpd",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1",
+                  varz=None) -> MetricsServer:
+    """Start the endpoint; returns the running server (port 0 = pick a
+    free port; read `.port`/`.url`)."""
+    return MetricsServer(port=port, host=host, varz=varz)

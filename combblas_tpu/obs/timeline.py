@@ -1,0 +1,177 @@
+"""Unified host/device timeline: correlate spans, ledger dispatches,
+and `jax.profiler` annotations on one clock, and split the span-level
+`unaccounted` residual into *dispatch-glue* (host wall overlapped by a
+recorded device interaction) vs *host-idle* (wall no recorded activity
+explains).
+
+Everything here is pure post-processing over `trace.TRACER` records and
+`ledger.LEDGER` records — both stamp `time.perf_counter()` so their
+intervals compose directly. The only live piece is `region(...)`, which
+brackets a code region with an `obs.span` AND a `jax.profiler.
+TraceAnnotation` carrying the same region id, so device-side profiler
+timelines (when a profiler trace is being captured) correlate back to
+span records by name.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+
+from combblas_tpu.obs import ledger as _ledger
+from combblas_tpu.obs import trace as _trace
+
+_REGION_SEQ = itertools.count(1)
+
+
+@contextlib.contextmanager
+def region(name: str, category: str | None = None, **attrs):
+    """`obs.span` + `jax.profiler.TraceAnnotation` with a shared region
+    id (`rN`), so profiler timelines correlate to span records. Falls
+    back to a plain span when the profiler is unavailable. Zero
+    overhead when tracing is disabled."""
+    if not _trace._ENABLED:
+        yield _trace._NOOP
+        return
+    rid = f"r{next(_REGION_SEQ)}"
+    ann = None
+    try:
+        from jax.profiler import TraceAnnotation
+        ann = TraceAnnotation(f"{name}#{rid}")
+    except Exception:       # pragma: no cover - profiler unavailable
+        ann = None
+    with _trace.span(name, category, region_id=rid, **attrs) as sp:
+        if ann is not None:
+            with ann:
+                yield sp
+        else:
+            yield sp
+
+
+# ------------------------------------------------------------- intervals
+
+def _union(intervals):
+    """Merge overlapping [t0, t1) intervals; returns merged list."""
+    ivs = sorted((a, b) for a, b in intervals if b > a)
+    out = []
+    for a, b in ivs:
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1] = (out[-1][0], b)
+        else:
+            out.append((a, b))
+    return out
+
+
+def _clip(intervals, lo, hi):
+    return [(max(a, lo), min(b, hi)) for a, b in intervals
+            if min(b, hi) > max(a, lo)]
+
+
+def _subtract(base, holes):
+    """base minus union(holes); all interval lists."""
+    out = []
+    holes = _union(holes)
+    for a, b in base:
+        cur = a
+        for h0, h1 in holes:
+            if h1 <= cur or h0 >= b:
+                continue
+            if h0 > cur:
+                out.append((cur, h0))
+            cur = max(cur, h1)
+            if cur >= b:
+                break
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def _span_len(intervals) -> float:
+    return sum(b - a for a, b in intervals)
+
+
+def _ledger_intervals(records=None, ledger=None):
+    recs = records if records is not None else \
+        (ledger if ledger is not None else _ledger.LEDGER).snapshot()
+    return [(r.t0, r.t0 + r.wall_s) for r in recs]
+
+
+# ------------------------------------------------------------- occupancy
+
+def occupancy(t0: float | None = None, t1: float | None = None,
+              span_name: str | None = None, records=None,
+              tracer=None, ledger=None) -> dict:
+    """Device-occupancy of a region: fraction of [t0, t1) overlapped by
+    at least one recorded device interaction (ledger dispatch/readback
+    walls — for `sync=True` instrumented sites these include device
+    execution, so "busy" means the device or its dispatch path was).
+
+    The region is either explicit [t0, t1) or the hull of all span
+    records named `span_name`. Returns {window_s, busy_s,
+    busy_fraction, dispatches}."""
+    if span_name is not None:
+        tr = tracer if tracer is not None else _trace.TRACER
+        recs = [r for r in tr.snapshot() if r.name == span_name]
+        if not recs:
+            return {"window_s": 0.0, "busy_s": 0.0,
+                    "busy_fraction": 0.0, "dispatches": 0}
+        t0 = min(r.t0 for r in recs)
+        t1 = max(r.t1 for r in recs)
+    if t0 is None or t1 is None or t1 <= t0:
+        return {"window_s": 0.0, "busy_s": 0.0, "busy_fraction": 0.0,
+                "dispatches": 0}
+    ivs = _clip(_ledger_intervals(records, ledger), t0, t1)
+    busy = _span_len(_union(ivs))
+    return {"window_s": t1 - t0, "busy_s": busy,
+            "busy_fraction": busy / (t1 - t0), "dispatches": len(ivs)}
+
+
+def coverage(t0: float, t1: float, records=None, ledger=None) -> float:
+    """Fraction of [t0, t1) covered by named ledger records — the
+    attribution metric: how much of a region's wall the flight recorder
+    can explain by executable name."""
+    return occupancy(t0=t0, t1=t1, records=records,
+                     ledger=ledger)["busy_fraction"]
+
+
+# ------------------------------------------------- unaccounted split
+
+def split_unaccounted(tracer=None, ledger=None) -> dict:
+    """Decompose the span-level `unaccounted` residual (self time of
+    category-less spans) into:
+
+      dispatch_glue_s — residual wall overlapped by a ledger record
+                        (the host was driving a named dispatch/readback
+                        the span taxonomy didn't categorize);
+      host_idle_s     — residual wall with NO recorded activity (pure
+                        python glue, GC, scheduling, ...).
+
+    Exact per-thread interval arithmetic: for each category-less span
+    record we reconstruct its SELF intervals (its window minus direct
+    children on the same thread) and intersect with ledger intervals.
+    """
+    tr = tracer if tracer is not None else _trace.TRACER
+    spans = tr.snapshot()
+    led_ivs = _union(_ledger_intervals(None, ledger))
+    glue = 0.0
+    idle = 0.0
+    by_parent: dict = {}
+    for r in spans:
+        if len(r.path) >= 2:
+            by_parent.setdefault((r.tid, r.path[:-1]), []).append(r)
+    for r in spans:
+        if r.category is not None:
+            continue
+        kids = by_parent.get((r.tid, r.path), ())
+        holes = [(k.t0, k.t1) for k in kids
+                 if k.t0 >= r.t0 and k.t1 <= r.t1 + 1e-9]
+        self_ivs = _subtract([(r.t0, r.t1)], holes)
+        covered = 0.0
+        for a, b in self_ivs:
+            covered += _span_len(_union(_clip(led_ivs, a, b)))
+        tot = _span_len(self_ivs)
+        glue += covered
+        idle += max(tot - covered, 0.0)
+    return {"dispatch_glue_s": glue, "host_idle_s": idle,
+            "unaccounted_s": glue + idle}

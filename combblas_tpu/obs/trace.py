@@ -25,6 +25,8 @@ Model:
 
 from __future__ import annotations
 
+import functools
+import itertools
 import threading
 import time
 
@@ -228,6 +230,68 @@ def sync(x) -> None:
         if hasattr(leaf, "ravel") and getattr(leaf, "size", 0) > 0:
             np.asarray(leaf.ravel()[0])
             return
+
+
+def current_path(tracer: Tracer | None = None) -> tuple:
+    """Path of the innermost open span on THIS thread (() at top level).
+    Cheap: one thread-local read; used by the dispatch ledger to stamp
+    records with their enclosing span."""
+    st = (tracer if tracer is not None else TRACER)._stack()
+    return st[-1]._path if st else ()
+
+
+def traced(name: str | None = None, category: str | None = None,
+           tracer: Tracer | None = None, **attrs):
+    """Decorator form of `span` — instrument a function without
+    indenting its body::
+
+        @obs.traced("bfs_plan", "host_compute")
+        def plan(...): ...
+
+    `name` defaults to the function's __name__. Also usable bare
+    (`@obs.traced` / `@obs.traced()`). Disabled mode costs one flag
+    check per call (the wrapper calls straight through)."""
+    if callable(name):                       # bare @obs.traced
+        fn, name = name, None
+        return traced(None, category, tracer)(fn)
+
+    def deco(fn):
+        span_name = name if name is not None else fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _ENABLED:
+                return fn(*args, **kwargs)
+            with span(span_name, category, tracer, **attrs):
+                return fn(*args, **kwargs)
+        wrapper.__wrapped__ = fn
+        return wrapper
+    return deco
+
+
+# ---------------------------------------------------------------- trace ids
+# Per-request correlation tokens: serve stamps one on each request at
+# admission, sets it on whichever thread is doing that request's work,
+# and the ledger/span layers copy the current id onto their records so
+# one request's activity links across queue -> batcher -> engine threads.
+
+_TRACE_SEQ = itertools.count(1)   # itertools.count is GIL-atomic
+_TRACE_TLS = threading.local()
+
+
+def new_trace_id() -> str:
+    """Mint a process-unique trace id (cheap, lock-free)."""
+    return f"t{next(_TRACE_SEQ):08x}"
+
+
+def set_trace_id(trace_id: str | None) -> None:
+    """Bind `trace_id` to the current thread (None clears)."""
+    _TRACE_TLS.tid = trace_id
+
+
+def get_trace_id() -> str | None:
+    """The trace id bound to the current thread, or None."""
+    return getattr(_TRACE_TLS, "tid", None)
 
 
 def reset(tracer: Tracer | None = None) -> None:

@@ -251,6 +251,13 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                      a.tile_m, b.tile_n)
 
 
+# flight-recorder boundary: every eager SUMMA dispatch lands in the
+# ledger by name with its capacity buckets visible in the arg shapes;
+# sync=True so wall_s includes device wall (the enclosing "summa" span
+# already synced, so this adds no extra device round trip)
+summa = obs.instrument(summa, "spgemm.summa", sync=True)
+
+
 def _bucket_cap(x: int, floor: int) -> int:
     """Round a dynamic capacity up to a power of two (>= floor): caps
     become coarse compile-shape buckets, so the phases of a budgeted
@@ -315,7 +322,9 @@ def _col_window(b: DistSpMat, lo: int, w: int) -> DistSpMat:
     # observed max nnz (one host sync per phase, in the host-side phase
     # loop anyway) is lossless; power-of-two buckets keep every phase
     # in the same compiled SUMMA (see _bucket_cap)
-    wcap = min(cap, _bucket_cap(int(np.asarray(out.nnz).max()), 128))
+    with obs.ledger.readback("spgemm.colwindow_nnz_readback",
+                             4 * pr * pc):
+        wcap = min(cap, _bucket_cap(int(np.asarray(out.nnz).max()), 128))
     return DistSpMat(out.rows[:, :wcap].reshape(pr, pc, wcap),
                      out.cols[:, :wcap].reshape(pr, pc, wcap),
                      out.vals[:, :wcap].reshape(pr, pc, wcap),
@@ -469,6 +478,18 @@ def _grow3(dr, dc, dv, *, grow: int, nrows: int, ncols: int):
             jnp.concatenate([dv, jnp.zeros((grow,), dv.dtype)]))
 
 
+# flight-recorder boundaries for the 1x1 window loop: the accumulator
+# helpers dispatch async (the enclosing "place" span syncs once), the
+# window kernel and final sort sync so their ledger wall is honest
+_place3 = obs.instrument(_place3, "spgemm.place3")
+_shrink_tile = obs.instrument(_shrink_tile, "spgemm.shrink_tile")
+_grow3 = obs.instrument(_grow3, "spgemm.grow3")
+_colwindow = obs.instrument(tl.spgemm_colwindow, "spgemm.colwindow",
+                            sync=True)
+_sort_compress = obs.instrument(tl.sort_compress, "spgemm.sort_compress",
+                                sync=True)
+
+
 def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                 phases: Optional[int], phase_flop_budget: int,
                 prune_hook, out_cap: Optional[int],
@@ -528,7 +549,7 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         with obs.span("window", w=wi, lo=lo, hi=hi, flops_cap=fc,
                       out_cap=oc) as w_:
             with obs.span("local", category="device_execute"):
-                cp = tl.spgemm_colwindow(
+                cp = _colwindow(
                     sr, at, bt, jnp.asarray(lo, jnp.int32),
                     jnp.asarray(hi, jnp.int32), flops_cap=fc, out_cap=oc,
                     win_width=win_width, b_struct=b_struct)
@@ -542,7 +563,8 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             # holding the flops-sized buffer OOMs the 16 GB HBM at
             # scale >= 16. One scalar readback per phase buys a bounded
             # working set — and makes the placement offsets host-known.
-            with obs.span("nnz_readback", category="host_readback"):
+            with obs.span("nnz_readback", category="host_readback"), \
+                    obs.ledger.readback("spgemm.nnz_readback", 4):
                 pn = int(np.asarray(cp.nnz))
             with obs.span("place", category="device_execute"):
                 cp = _shrink_tile(cp, new_cap=fit(pn, 128))
@@ -574,13 +596,14 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         else:
             # disjoint columns ⇒ no dedup; ONE sort restores (row, col)
             # order and pushes the interleaved sentinel padding last
-            out, _ = tl.sort_compress(sr.add, *acc, jnp.int32(nlive),
-                                      nrows=a.tile_m, ncols=b.tile_n,
-                                      cap=fit(nlive, cap_round),
-                                      dedup=False)
+            out, _ = _sort_compress(sr.add, *acc, jnp.int32(nlive),
+                                    nrows=a.tile_m, ncols=b.tile_n,
+                                    cap=fit(nlive, cap_round),
+                                    dedup=False)
         obs.sync(out.rows)
     if out_cap is not None and out.cap != out_cap:
-        with obs.span("nnz_readback", category="host_readback"):
+        with obs.span("nnz_readback", category="host_readback"), \
+                obs.ledger.readback("spgemm.nnz_readback", 4):
             need = int(np.asarray(out.nnz))
         _M_READBACK.inc(4)
         if out_cap < need:

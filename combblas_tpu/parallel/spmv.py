@@ -65,6 +65,11 @@ def spmv(sr: Semiring, a: DistSpMat, x: DistVec) -> DistVec:
     return DistVec(data, a.grid, ROW_AXIS, a.nrows)
 
 
+from combblas_tpu import obs as _obs  # noqa: E402 (after jit defs)
+
+spmv = _obs.instrument(spmv, "spmv.spmv")
+
+
 @partial(jax.jit, static_argnames=("sr",))
 def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
     """y = A ⊗ x with sparse (masked) x — SpMSpV (≅ ParFriends.h:1725 /
@@ -90,6 +95,9 @@ def spmsv(sr: Semiring, a: DistSpMat, x: DistSpVec) -> DistSpVec:
         out_specs=(P(ROW_AXIS, None), P(ROW_AXIS, None)),
     )(a.rows, a.cols, a.vals, a.nnz, x.data, x.active)
     return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)
+
+
+spmsv = _obs.instrument(spmsv, "spmv.spmsv")
 
 
 @partial(jax.jit, static_argnames=("grid", "axis", "glen", "tile_n"))
@@ -143,6 +151,13 @@ def _spmsv_fanin(sr: Semiring, a: DistSpMat, yp, hp):
         out_specs=(P(ROW_AXIS, None),) * 2,
     )(yp, hp)
     return DistSpVec(data, active, a.grid, ROW_AXIS, a.nrows)
+
+
+# the attribution entry point dispatches its three phases separately;
+# name each in the ledger (the enclosing spans sync, so async is fine)
+_spmsv_fanout = _obs.instrument(_spmsv_fanout, "spmv.fanout")
+_spmsv_local = _obs.instrument(_spmsv_local, "spmv.local")
+_spmsv_fanin = _obs.instrument(_spmsv_fanin, "spmv.fanin")
 
 
 def spmsv_timed(sr: Semiring, a: DistSpMat, y_prev: DistSpVec,

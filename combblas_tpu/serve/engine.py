@@ -52,8 +52,8 @@ from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
 from combblas_tpu.serve.batcher import Batch, DynamicBatcher, bucket_for
 from combblas_tpu.serve.plans import PlanCache, PlanKey
 from combblas_tpu.serve.queue import (
-    DeadlineExceededError, Request, RequestQueue, ResultHandle,
-    ServiceStoppedError,
+    DeadlineExceededError, QueueFullError, Request, RequestQueue,
+    ResultHandle, ServiceStoppedError,
 )
 from combblas_tpu.utils.config import ServeConfig
 
@@ -70,6 +70,8 @@ _latency = obs.histogram(
 _dispatches = obs.counter("serve.dispatches",
                           "device dispatches by query kind")
 _shed = obs.counter("serve.shed", "requests shed, by reason")
+_queue_hw = obs.gauge("serve.queue_high_water",
+                      "deepest the request queue has ever been")
 
 
 @dataclasses.dataclass
@@ -114,7 +116,7 @@ class GraphService:
         # when tracing is enabled; `stats` always counts
         self.stats = {"queries": 0, "results": 0, "batches": 0,
                       "dispatches": 0, "warmup_dispatches": 0,
-                      "shed": 0, "partials": 0}
+                      "shed": 0, "partials": 0, "rejected": 0}
         self._stats_lock = threading.Lock()
         self._mesh = (a.grid.pr, a.grid.pc)
         self._bfs_level_est = self.cfg.bfs_level_est_s
@@ -134,6 +136,7 @@ class GraphService:
         self._cc_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._metrics_server = None
         if autostart:
             self.start()
 
@@ -161,6 +164,41 @@ class GraphService:
         self._thread.join()
         self._thread = None
         self._fail_pending()    # anything that raced the final check
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+
+    def start_metrics_server(self, port: int = 0,
+                             host: str = "127.0.0.1"):
+        """Expose `/metrics` (Prometheus), `/varz` (JSON), `/healthz`
+        on a daemon thread — entirely off the dispatch path (handlers
+        only read snapshots). Port 0 picks a free port; returns the
+        running `obs.httpd.MetricsServer` (read `.url`). Stopped by
+        `stop()`."""
+        if self._metrics_server is None:
+            self._metrics_server = obs.serve_metrics(
+                port=port, host=host, varz=self._varz)
+        return self._metrics_server
+
+    def _varz(self) -> dict:
+        """Service block of /varz, and the /healthz verdict: healthy
+        iff the worker thread is actually alive (or the service was
+        never started / cleanly stopped — a crashed worker is the
+        unhealthy case)."""
+        started = self._thread is not None
+        with self._stats_lock:
+            stats = dict(self.stats)
+        return {
+            "healthy": (not started) or self._thread.is_alive(),
+            "started": started,
+            "stats": stats,
+            "queue_depth": len(self.queue),
+            "queue_high_water": self.queue.high_water,
+            "plan_cache": self.plans.stats(),
+            "plans": len(self.plans),
+            "cost_est_s": dict(self._cost_est),
+            "bfs_level_est_s": self._bfs_level_est,
+        }
 
     def _fail_pending(self) -> None:
         for r in self.queue.drain():
@@ -189,11 +227,21 @@ class GraphService:
             deadline_s = self.cfg.default_deadline_s
         now = time.monotonic()
         deadline = None if deadline_s is None else now + deadline_s
-        h = ResultHandle()
-        self.queue.put(Request(kind, payload, h, deadline, now))
+        trace_id = obs.new_trace_id()
+        h = ResultHandle(trace_id)
+        req = Request(kind, payload, h, deadline, now, trace_id)
+        try:
+            self.queue.put(req)
+        except QueueFullError:
+            self._note_rejected(req, "queue_full")
+            raise
+        except DeadlineExceededError:
+            self._note_rejected(req, "deadline")
+            raise
         with self._stats_lock:
             self.stats["queries"] += 1
         _queue_depth.set(len(self.queue))
+        _queue_hw.set(self.queue.high_water)
         return h
 
     def submit_bfs(self, root: int,
@@ -302,16 +350,27 @@ class GraphService:
             batch = self._shed_predicted(batch)
             if batch is None:
                 return
-        with obs.span("serve.batch", kind=batch.kind,
-                      width=len(batch.requests), bucket=batch.bucket):
-            if batch.kind == "bfs":
-                self._run_bfs(batch)
-            elif batch.kind == "cc":
-                self._run_cc(batch)
-            elif batch.kind.startswith("spmv:"):
-                self._run_spmv(batch)
-            else:
-                raise ValueError(f"unknown query kind {batch.kind!r}")
+        # propagate the request trace ids onto the worker thread: the
+        # batch binds its head request's id thread-locally (ledger
+        # records stamp it) and lists EVERY member id on the batch span
+        # so one request's activity links queue -> batcher -> engine
+        ids = [r.trace_id for r in batch.requests]
+        obs.set_trace_id(ids[0])
+        try:
+            with obs.span("serve.batch", kind=batch.kind,
+                          width=len(batch.requests), bucket=batch.bucket,
+                          trace_ids=ids):
+                if batch.kind == "bfs":
+                    self._run_bfs(batch)
+                elif batch.kind == "cc":
+                    self._run_cc(batch)
+                elif batch.kind.startswith("spmv:"):
+                    self._run_spmv(batch)
+                else:
+                    raise ValueError(
+                        f"unknown query kind {batch.kind!r}")
+        finally:
+            obs.set_trace_id(None)
         with self._stats_lock:
             self.stats["batches"] += 1
         _occupancy.observe(batch.occupancy, kind=batch.kind)
@@ -326,6 +385,16 @@ class GraphService:
     def _note_shed(self, req: Request, reason: str) -> None:
         with self._stats_lock:
             self.stats["shed"] += 1
+        _shed.inc(kind=req.kind, reason=reason)
+
+    def _note_rejected(self, req: Request, reason: str) -> None:
+        """Admission-time refusals (queue_full backpressure, dead on
+        arrival). Counted separately from `shed`: the caller got the
+        exception synchronously, nothing was ever queued — but the
+        shed counter still carries the reason label so `/metrics`
+        shows every loss mode in one family."""
+        with self._stats_lock:
+            self.stats["rejected"] += 1
         _shed.inc(kind=req.kind, reason=reason)
 
     def _count_dispatch(self, kind: str, warmup: bool = False) -> None:

@@ -37,6 +37,12 @@ class PlanKey(NamedTuple):
     #                    per uint32 word; 0 = dense/unpacked executable)
 
 
+def _plan_name(key: PlanKey) -> str:
+    sr = "" if key.semiring in ("-", "") else f".{key.semiring}"
+    lanes = f".l{key.lanes}" if key.lanes else ""
+    return f"serve.{key.kind}{sr}/w{key.bucket}{lanes}"
+
+
 @dataclasses.dataclass
 class PlanEntry:
     fn: Callable
@@ -69,8 +75,12 @@ class PlanCache:
                 _plan_hits.inc(kind=key.kind, bucket=key.bucket)
                 return e.fn
         # build OUTSIDE the lock (compiles are long; lookups of other
-        # keys must not stall behind them), then settle races under it
-        fn = builder()
+        # keys must not stall behind them), then settle races under it.
+        # Every built executable goes through the dispatch ledger — one
+        # wrapper per plan, named by its key, so serve dispatches land
+        # in the flight recorder with executable-level attribution
+        # (pass-through when the ledger is disabled).
+        fn = obs.instrument(builder(), _plan_name(key))
         with self._lock:
             e = self._plans.get(key)
             if e is None:

@@ -41,9 +41,12 @@ class ServiceStoppedError(ServeError):
 
 class ResultHandle:
     """Future for one request: the worker thread fulfills it, the
-    client blocks on `result()`."""
+    client blocks on `result()`. Carries the request's ``trace_id`` so
+    clients can correlate their result with the spans/ledger records
+    the service stamped along the way."""
 
-    def __init__(self):
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id
         self._event = threading.Event()
         self._result: Any = None
         self._exc: Optional[BaseException] = None
@@ -79,6 +82,7 @@ class Request:
     handle: ResultHandle
     deadline: Optional[float]       # absolute time.monotonic(), or None
     enqueued_at: float
+    trace_id: Optional[str] = None  # correlation token, queue -> engine
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:
@@ -100,6 +104,7 @@ class RequestQueue:
         if max_depth < 1:
             raise ValueError("max_depth must be >= 1")
         self.max_depth = max_depth
+        self.high_water = 0         # deepest the queue has ever been
         self._q: collections.deque = collections.deque()
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
@@ -119,6 +124,8 @@ class RequestQueue:
                 raise QueueFullError(
                     f"queue at max depth {self.max_depth}")
             self._q.append(req)
+            if len(self._q) > self.high_water:
+                self.high_water = len(self._q)
             self._nonempty.notify()
 
     def wait_nonempty(self, timeout: Optional[float] = None) -> bool:

@@ -10,6 +10,9 @@ Passes (all trace/AST only — nothing compiles or runs device code):
   retrace   retrace-drift detector over the serve bucket ladder vs the
             committed expected-compile counts (retrace_serve.json)
   locks     lock-order / threading lint over combblas_tpu/
+  obs       obs-residual budgets over committed bench artifacts:
+            unaccounted_s fractions, dispatch counts, ledger coverage
+            (obs_residual.json)
 
 Exit status: 0 iff no unsuppressed finding (the CI gate contract —
 `pytest -m quick` runs the same passes via tests/test_analysis.py).
@@ -67,6 +70,10 @@ def run_passes(passes, entry=None):
         t0 = time.time()
         findings += analysis.run_lockorder()
         timings["locks"] = time.time() - t0
+    if "obs" in passes and entry is None:
+        t0 = time.time()
+        findings += analysis.run_obs()
+        timings["obs"] = time.time() - t0
     return findings, timings
 
 
@@ -116,6 +123,29 @@ def self_test() -> int:
     expect("drift sweep", {f.rule for f in fs},
            core.RETRACE_PY_SCALAR, core.RETRACE_DRIFT)
 
+    print("fixture: bad_obs_budget.json")
+    from combblas_tpu.analysis import obsbudget
+    fs = obsbudget.run_obs(files=[fx / "bad_obs_budget.json"], root=fx)
+    expect("obs budget overshoot", {f.rule for f in fs},
+           core.OBS_RESIDUAL, core.OBS_DISPATCH_COUNT, core.OBS_STALE)
+    # the waived entry must be suppressed: exactly ONE dispatch-count
+    # finding survives (the unwaived one), not two
+    counts = [f for f in fs if f.rule == core.OBS_DISPATCH_COUNT]
+    if len(counts) != 2:   # path overshoot + executable overshoot
+        failures.append(f"bad_obs_budget.json: expected exactly 2 "
+                        f"surviving dispatch-count findings (path + "
+                        f"executable; the waived entry suppressed), "
+                        f"got {len(counts)}")
+    else:
+        print("  [ok] bad_obs_budget.json: allow-list honored")
+    missing = obsbudget.run_obs(files=[fx / "bad_obs_budget.json"])
+    if not any(f.rule == core.OBS_STALE and "not found" in f.message
+               for f in missing):
+        failures.append("bad_obs_budget.json: missing artifact did "
+                        "not flag obs-stale-artifact")
+    else:
+        print("  [ok] bad_obs_budget.json: missing artifact flagged")
+
     for fname, rule in [("bad_lock_cycle.py", core.LOCK_CYCLE),
                         ("bad_jit_under_lock.py", core.JIT_UNDER_LOCK),
                         ("bad_bare_acquire.py", core.BARE_ACQUIRE)]:
@@ -151,8 +181,8 @@ def main() -> int:
                          "bad-pattern fixtures")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings")
-    ap.add_argument("--passes", default="budgets,retrace,locks",
-                    help="comma list of budgets,retrace,locks")
+    ap.add_argument("--passes", default="budgets,retrace,locks,obs",
+                    help="comma list of budgets,retrace,locks,obs")
     ap.add_argument("--entry", default=None,
                     help="restrict the budget pass to one entry point")
     args = ap.parse_args()
@@ -162,7 +192,7 @@ def main() -> int:
         return self_test()
 
     passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
-    bad = set(passes) - {"budgets", "retrace", "locks"}
+    bad = set(passes) - {"budgets", "retrace", "locks", "obs"}
     if bad:
         ap.error(f"unknown pass(es): {sorted(bad)}")
     findings, timings = run_passes(passes, entry=args.entry)

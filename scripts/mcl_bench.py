@@ -90,6 +90,7 @@ def main():
 
     obs.reset()
     obs.REGISTRY.reset()
+    obs.ledger.reset()
     obs.set_enabled(True)
     t0 = time.perf_counter()
     labels, ncl, iters = M.mcl(
@@ -103,7 +104,9 @@ def main():
         print(f"# ladder: {len(ladder.rungs)} rungs -> {ladder_path}",
               file=sys.stderr, flush=True)
     breakdown = obs.export.phase_breakdown()
+    dispatches = obs.dispatch_summary()
     print(obs.export.format_report(min_s=0.01), file=sys.stderr, flush=True)
+    print(obs.ledger.format_table(), file=sys.stderr, flush=True)
 
     # cluster recovery quality: fraction of same-planted-cluster vertex
     # pairs (sampled) that land in the same found cluster
@@ -126,6 +129,7 @@ def main():
         "unaccounted_s": round(breakdown["unaccounted"], 4),
         "spans": obs.export.report(),
         "metrics": obs.REGISTRY.snapshot(),
+        "dispatch_summary": dispatches,
         "note": "HipMCL loop (phased pruned SpGEMM + inflate + chaos) "
                 "on a planted-partition graph, one v5e chip through the "
                 "relay tunnel. Round 5: one CapLadder pins capacity "

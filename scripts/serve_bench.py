@@ -170,6 +170,7 @@ def main():
         obs.set_enabled(True)
         obs.reset()
         obs.REGISTRY.reset()
+        obs.ledger.reset()
         svc = serve.GraphService(a, cfg)
         svc.warmup(kinds=("bfs", "cc"))
         shed = 0
@@ -221,7 +222,9 @@ def main():
                "batches": svc.stats["batches"],
                "batch_occupancy_mean": occupancy_mean(),
                "latency": percentiles(),
-               "plan_cache": svc.plans.stats()}
+               "plan_cache": svc.plans.stats(),
+               "rejected": svc.stats["rejected"],
+               "dispatch_summary": obs.dispatch_summary()}
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -373,6 +376,7 @@ def run_bits(args):
         obs.set_enabled(True)
         obs.reset()
         obs.REGISTRY.reset()
+        obs.ledger.reset()
         svc = serve.GraphService(a, cfg, plan=plan)
         svc.warmup(kinds=("bfs", "cc"))
         t0 = time.perf_counter()
@@ -406,7 +410,8 @@ def run_bits(args):
                "dispatches": svc.stats["dispatches"],
                "batch_occupancy_mean": occ_mean,
                "buckets": list(cfg.buckets),
-               "plan_cache": svc.plans.stats()}
+               "plan_cache": svc.plans.stats(),
+               "dispatch_summary": obs.dispatch_summary()}
         svc.stop()
         obs.set_enabled(False)
         print(json.dumps(rec), flush=True)

@@ -10,6 +10,11 @@ Steps (each standalone, continues past failures):
      matters: the gate forces the CPU backend and must not clobber
      this process's TPU client. A failing gate aborts the checklist
      (there is no point benchmarking a lowering that regressed).
+  0b. (--obs) flight-recorder smoke: enable the obs layer, run one
+     tiny instrumented BFS, start the /metrics endpoint, scrape
+     /metrics + /varz + /healthz over real HTTP, and verify the
+     dispatch ledger recorded the executables. Proves the recorder
+     works against THIS backend before any long step runs blind.
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -18,6 +23,7 @@ Steps (each standalone, continues past failures):
 """
 
 import argparse
+import json
 import os
 import pathlib
 import subprocess
@@ -46,6 +52,63 @@ def run_analysis_gate() -> bool:
     return r.returncode == 0
 
 
+def run_obs_check(grid) -> bool:
+    """Step 0b: flight-recorder smoke — instrumented BFS, live
+    endpoint scrape, ledger non-empty."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu import obs
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm
+
+    step("0b. flight-recorder smoke (--obs)")
+    ok = True
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.set_enabled(True)
+    srv = obs.serve_metrics(port=0)
+    try:
+        n = 1 << 8
+        r, c = generate.rmat_edges(jax.random.key(3), 8, 8)
+        a = dm.from_global_coo(S.LOR, grid, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        B.bfs(a, 0)
+        recs = obs.ledger.LEDGER.snapshot()
+        names = sorted({x.name for x in recs})
+        print(f"ledger: {len(recs)} record(s): {names}")
+        if not recs:
+            print("FAIL: instrumented BFS left the ledger EMPTY")
+            ok = False
+        bodies = {}
+        for path in ("/healthz", "/varz", "/metrics"):
+            with urllib.request.urlopen(srv.url + path, timeout=10) as f:
+                bodies[path] = f.read().decode()
+                print(f"GET {path}: {f.status}, "
+                      f"{len(bodies[path])} bytes")
+                if f.status != 200:
+                    ok = False
+        obs.parse_prometheus(bodies["/metrics"])   # format must parse
+        varz = json.loads(bodies["/varz"])
+        if varz.get("ledger", {}).get("total", 0) < 1:
+            print("FAIL: /varz reports an EMPTY ledger over HTTP")
+            ok = False
+        print(obs.ledger.format_table(k=5))
+        print("flight recorder:", "OK" if ok else "FAILED")
+    except Exception:
+        traceback.print_exc()
+        ok = False
+    finally:
+        srv.stop()
+        obs.set_enabled(False)
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="on-chip validation + perf checklist")
@@ -53,6 +116,9 @@ def main():
                     help="run the static-analysis gate (scripts/"
                          "analyze.py) before the on-chip steps; a "
                          "failing gate aborts the checklist")
+    ap.add_argument("--obs", action="store_true",
+                    help="flight-recorder smoke: instrumented BFS, "
+                         "live /metrics scrape, ledger non-empty")
     args = ap.parse_args()
     if args.analysis and not run_analysis_gate():
         sys.exit(1)
@@ -69,6 +135,9 @@ def main():
     from combblas_tpu.models import bfs as B
 
     grid = ProcGrid.make(1, 1, jax.devices()[:1])
+
+    if args.obs and not run_obs_check(grid):
+        sys.exit(1)
 
     step("1. pallas scan on-chip")
     try:

@@ -1,4 +1,4 @@
-"""Static-analysis gate (combblas_tpu.analysis): the three passes run
+"""Static-analysis gate (combblas_tpu.analysis): the four passes run
 clean on the merged tree, each rule demonstrably FIRES on its
 committed bad-pattern fixture under tests/fixtures/analysis/, and the
 retrace signature model agrees with jax's actual compile behavior.
@@ -17,7 +17,7 @@ import pytest
 
 from combblas_tpu import analysis
 from combblas_tpu.analysis import (budget, core, entries, hlo, lockorder,
-                                   retrace)
+                                   obsbudget, retrace)
 
 pytestmark = pytest.mark.quick
 
@@ -45,6 +45,14 @@ def test_retrace_pass_clean_on_tree():
 
 def test_lockorder_pass_clean_on_tree():
     fs = lockorder.run_lockorder()
+    assert not fs, _fmt(fs)
+
+
+def test_obs_pass_clean_on_tree():
+    """The committed residual budgets hold against the committed bench
+    artifacts (SERVE_BENCH/BITS_BENCH dispatch counts, instrumentation
+    coverage, MCL unaccounted fraction)."""
+    fs = obsbudget.run_obs()
     assert not fs, _fmt(fs)
 
 
@@ -139,6 +147,48 @@ def test_bare_acquire_fixture_fires_and_suppression_holds():
     assert "def leaky" in src[bares[0].line - 2]
 
 
+def test_obs_budget_fixture_fires_all_three_rules():
+    """The paired bad artifact overshoots the unaccounted fraction, a
+    dispatch-count path, AND a per-executable ledger ceiling, while a
+    required ledger name matches nothing — every obs rule fires, each
+    anchored to the budget file."""
+    fs = obsbudget.run_obs(files=[FIXTURES / "bad_obs_budget.json"],
+                           root=FIXTURES)
+    rules = {f.rule for f in fs}
+    assert {core.OBS_RESIDUAL, core.OBS_DISPATCH_COUNT,
+            core.OBS_STALE} <= rules, _fmt(fs)
+    for f in fs:
+        assert f.file.endswith("bad_obs_budget.json")
+
+
+def test_obs_budget_allow_list_waives():
+    # the second fixture entry repeats the dispatch overshoot but
+    # carries allow:["obs-dispatch-count"] — exactly the unwaived
+    # entry's two count findings (path + executable) survive
+    fs = obsbudget.run_obs(files=[FIXTURES / "bad_obs_budget.json"],
+                           root=FIXTURES)
+    counts = [f for f in fs if f.rule == core.OBS_DISPATCH_COUNT]
+    assert len(counts) == 2, _fmt(counts)
+
+
+def test_obs_missing_artifact_is_stale():
+    # resolved against the repo root (default), the fixture's artifact
+    # does not exist -> every entry collapses to one stale finding
+    fs = obsbudget.run_obs(files=[FIXTURES / "bad_obs_budget.json"])
+    assert any(f.rule == core.OBS_STALE and "not found" in f.message
+               for f in fs), _fmt(fs)
+
+
+def test_obs_ledger_name_prefix_match():
+    # bucket-parameterized plan names satisfy a bare prefix at a
+    # "/" or "." boundary; lookalike prefixes must NOT match
+    assert obsbudget._name_covered("serve.bfs", {"serve.bfs/w32"})
+    assert obsbudget._name_covered("serve.bfs", {"serve.bfs.bits/w64.l32"})
+    assert obsbudget._name_covered("serve.bfs", {"serve.bfs"})
+    assert not obsbudget._name_covered("serve.bfs", {"serve.bfs2/w4"})
+    assert not obsbudget._name_covered("serve.bfs", {"serve"})
+
+
 def test_pr4_deadlock_shape_is_seen_and_deliberately_waived():
     """Regression guard for the PR-4 hang: the lint must still SEE the
     jit-dispatch-under-lock sites in serve/engine.py (the raw analyzer
@@ -207,7 +257,7 @@ def test_bits_ladder_folds_to_one_signature():
 # ---------------------------------------------------------------------------
 
 def test_run_all_selected_passes_clean():
-    assert analysis.run_all(passes=("retrace", "locks")) == []
+    assert analysis.run_all(passes=("retrace", "locks", "obs")) == []
 
 
 def test_cli_gate_exit_codes():
@@ -217,7 +267,7 @@ def test_cli_gate_exit_codes():
     finds violations (driven via the self-test fixtures)."""
     r = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "analyze.py"),
-         "--gate", "--passes", "locks,retrace"],
+         "--gate", "--passes", "locks,retrace,obs"],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "PASS" in r.stdout

@@ -1,0 +1,235 @@
+"""Dispatch-ledger tests: ring-buffer wrap semantics, the disabled-
+mode zero-overhead contract (no allocation, no arg inspection, no
+records), trace-safety (in-jit calls pass through), compile detection,
+manual readback bracketing, and the top-K aggregation."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.obs import ledger, trace
+
+
+@pytest.fixture
+def obs_on():
+    """Tracing + ledger armed for one test; global state restored and
+    cleared either way."""
+    was = trace.enabled()
+    trace.set_enabled(True)
+    trace.reset()
+    ledger.reset()
+    yield
+    trace.set_enabled(was)
+    trace.reset()
+    ledger.reset()
+
+
+def _fill(led, n, name="x"):
+    for i in range(n):
+        led._write(led._claim(), ledger.DispatchRecord(
+            i, name, "dispatch", 0.0, 0.001, (), 0, 0, False, (), 0, ""))
+
+
+# ---------------------------------------------------------------------------
+# ring buffer
+# ---------------------------------------------------------------------------
+
+def test_ring_wrap_drops_oldest(obs_on):
+    led = ledger.Ledger(capacity=8)
+    for i in range(20):
+        ledger.record(f"n{i}", "dispatch", 0.0, 0.001, ledger=led)
+    assert led.total == 20
+    assert led.dropped == 12
+    recs = led.snapshot()
+    assert len(recs) == 8
+    # survivors are exactly the newest 8, in sequence order
+    assert [r.seq for r in recs] == list(range(12, 20))
+    assert [r.name for r in recs] == [f"n{i}" for i in range(12, 20)]
+
+
+def test_ring_reset_clears_everything(obs_on):
+    led = ledger.Ledger(capacity=4)
+    _fill(led, 10)
+    led.reset()
+    assert led.total == 0 and led.dropped == 0
+    assert led.snapshot() == []
+
+
+def test_ring_capacity_validation():
+    with pytest.raises(ValueError):
+        ledger.Ledger(capacity=0)
+
+
+def test_ring_concurrent_writers_lose_nothing_in_count(obs_on):
+    led = ledger.Ledger(capacity=4096)
+    nthreads, per = 8, 200
+
+    def worker(t):
+        for i in range(per):
+            ledger.record(f"t{t}", "dispatch", 0.0, 1e-6, ledger=led)
+
+    ts = [threading.Thread(target=worker, args=(t,))
+          for t in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert led.total == nthreads * per
+    assert len(led.snapshot()) == nthreads * per   # fits: no wrap
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: the zero-overhead contract
+# ---------------------------------------------------------------------------
+
+class _Detonator:
+    """Explodes on ANY attribute access: proves the disabled wrapper
+    never inspects its arguments (no tree flatten, no .shape reads)."""
+
+    def __getattribute__(self, name):
+        raise AssertionError(f"disabled ledger touched .{name}")
+
+
+def test_disabled_wrapper_is_pure_passthrough():
+    was = trace.enabled()
+    trace.set_enabled(False)
+    ledger.reset()
+    try:
+        seen = []
+        wrapped = ledger.instrument(lambda *a: seen.append(len(a)) or 7,
+                                    "test.disabled_pass")
+        out = wrapped(_Detonator(), _Detonator())
+        assert out == 7 and seen == [2]
+        assert ledger.LEDGER.total == 0          # nothing recorded
+    finally:
+        trace.set_enabled(was)
+        ledger.reset()
+
+
+def test_disabled_record_and_readback_are_noops():
+    was = trace.enabled()
+    trace.set_enabled(False)
+    try:
+        ledger.record("test.noop", "dispatch", 0.0, 1.0)
+        with ledger.readback("test.noop_rb", out_bytes=128):
+            pass
+        assert ledger.LEDGER.total == 0
+    finally:
+        trace.set_enabled(was)
+
+
+def test_ledger_sub_switch_disarms_under_enabled_trace(obs_on):
+    """trace on + ledger sub-switch off: spans still record, the
+    per-dispatch recorder stays silent AND untouched."""
+    ledger.set_enabled(False)
+    try:
+        wrapped = ledger.instrument(lambda x: x, "test.subswitch")
+        assert wrapped(_Detonator()) is not None
+        assert ledger.LEDGER.total == 0
+    finally:
+        ledger.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# instrument: recording, compile detection, trace-safety
+# ---------------------------------------------------------------------------
+
+def test_instrument_records_dispatch_and_compile_flag(obs_on):
+    led = ledger.Ledger(capacity=64)
+    f = jax.jit(lambda x: x * 2)
+    wrapped = ledger.instrument(f, "test.double", ledger=led)
+    x = jnp.arange(8, dtype=jnp.int32)
+    np.testing.assert_array_equal(np.asarray(wrapped(x)),
+                                  np.arange(8) * 2)
+    wrapped(x)
+    recs = led.snapshot()
+    assert [r.name for r in recs] == ["test.double", "test.double"]
+    assert recs[0].compiled and not recs[1].compiled
+    assert recs[0].arg_shapes == ("int32[8]",)
+    assert recs[0].arg_bytes == 32
+    assert recs[0].kind == "dispatch"
+    assert "test.double" in ledger.INSTRUMENTED
+
+
+def test_instrument_passes_through_under_jit_trace(obs_on):
+    led = ledger.Ledger(capacity=64)
+    wrapped = ledger.instrument(lambda x: x + 1, "test.inner", ledger=led)
+
+    @jax.jit
+    def outer(x):
+        return wrapped(x) * 3
+
+    out = outer(jnp.int32(4))
+    assert int(out) == 15
+    # the traced inner call must NOT have recorded; only eager calls do
+    assert led.total == 0
+    wrapped(jnp.int32(1))
+    assert led.total == 1
+
+
+def test_instrument_captures_span_path_and_trace_id(obs_on):
+    led = ledger.Ledger(capacity=64)
+    wrapped = ledger.instrument(lambda x: x, "test.ctx", ledger=led)
+    tid = trace.new_trace_id()
+    trace.set_trace_id(tid)
+    try:
+        with trace.span("phase_a"):
+            wrapped(jnp.int32(0))
+    finally:
+        trace.set_trace_id(None)
+    (rec,) = led.snapshot()
+    assert rec.path and rec.path[-1] == "phase_a"
+    assert rec.trace_id == tid
+
+
+def test_instrument_sync_includes_device_wall(obs_on):
+    led = ledger.Ledger(capacity=64)
+    f = jax.jit(lambda x: jnp.sum(x * x))
+    wrapped = ledger.instrument(f, "test.sync", sync=True, ledger=led)
+    wrapped(jnp.ones((256,), jnp.float32))
+    (rec,) = led.snapshot()
+    assert rec.wall_s > 0
+
+
+def test_instrument_rejects_unknown_kind():
+    with pytest.raises(ValueError):
+        ledger.instrument(lambda: None, "test.bad", kind="mystery")
+
+
+# ---------------------------------------------------------------------------
+# manual readbacks
+# ---------------------------------------------------------------------------
+
+def test_readback_context_records_bytes_and_wall(obs_on):
+    led = ledger.Ledger(capacity=64)
+    with ledger.readback("test.fetch", out_bytes=4096, ledger=led):
+        time.sleep(0.005)
+    (rec,) = led.snapshot()
+    assert rec.kind == "readback"
+    assert rec.out_bytes == 4096
+    assert rec.wall_s >= 0.004
+
+
+# ---------------------------------------------------------------------------
+# top-K aggregation
+# ---------------------------------------------------------------------------
+
+def test_top_k_by_wall_and_count(obs_on):
+    led = ledger.Ledger(capacity=64)
+    for _ in range(5):
+        ledger.record("fast", "dispatch", 0.0, 0.001, ledger=led)
+    ledger.record("slow", "dispatch", 0.0, 1.0, compiled=True,
+                  ledger=led)
+    by_wall = ledger.top_k(2, by="wall", ledger=led)
+    assert [r["name"] for r in by_wall] == ["slow", "fast"]
+    assert by_wall[0]["compiles"] == 1
+    by_count = ledger.top_k(2, by="count", ledger=led)
+    assert [r["name"] for r in by_count] == ["fast", "slow"]
+    assert by_count[0]["count"] == 5
+    assert by_count[0]["mean_s"] == pytest.approx(0.001)
+    table = ledger.format_table(k=2, ledger=led)
+    assert "slow" in table and "fast" in table and "6 records" in table

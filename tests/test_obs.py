@@ -500,3 +500,156 @@ def test_timing_shim_timers_still_stamp():
     rep = t.report()
     assert rep["local"]["calls"] == 1
     assert rep["local"]["total_s"] >= 0.002
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition: render -> parse round-trip (no sockets)
+# ---------------------------------------------------------------------------
+
+def test_prometheus_round_trip_counters_gauges(obs_on):
+    from combblas_tpu.obs import httpd
+
+    c = metrics.counter("t.rt_counter", "things done")
+    c.inc(3, kind="bfs")
+    c.inc(2, kind="cc")
+    g = metrics.gauge("t.rt_gauge", "level")
+    g.set(7.5)
+    text = httpd.prometheus_text()
+    series = httpd.parse_prometheus(text)     # raises on bad exposition
+    assert series[("t_rt_counter", (("kind", "bfs"),))] == 3
+    assert series[("t_rt_counter", (("kind", "cc"),))] == 2
+    assert series[("t_rt_gauge", ())] == 7.5
+
+
+def test_prometheus_histogram_and_p2_quantiles_round_trip(obs_on):
+    """Histogram families stay valid exposition (cumulative buckets,
+    _sum/_count) and the streaming quantile estimates ride along as a
+    SEPARATE _quantile gauge family with quantile labels."""
+    from combblas_tpu.obs import httpd
+
+    h = metrics.histogram("t.rt_hist", "walls", bounds=(0.1, 1.0))
+    for x in (0.05, 0.5, 0.5, 2.0):
+        h.observe(x, kind="q")
+    text = httpd.prometheus_text()
+    series = httpd.parse_prometheus(text)
+    lbl = ("kind", "q")
+    assert series[("t_rt_hist_bucket", (lbl, ("le", "0.1")))] == 1
+    assert series[("t_rt_hist_bucket", (lbl, ("le", "1")))] == 3
+    assert series[("t_rt_hist_bucket", (lbl, ("le", "+Inf")))] == 4
+    assert series[("t_rt_hist_count", (lbl,))] == 4
+    assert series[("t_rt_hist_sum", (lbl,))] == pytest.approx(3.05)
+    # p50 over {0.05, 0.5, 0.5, 2.0} is 0.5 (nearest rank)
+    assert series[("t_rt_hist_quantile",
+                   (lbl, ("quantile", "0.5")))] == pytest.approx(0.5)
+
+
+def test_prometheus_escapes_label_values(obs_on):
+    from combblas_tpu.obs import httpd
+
+    c = metrics.counter("t.rt_escape")
+    c.inc(1, path='a"b\\c')
+    series = httpd.parse_prometheus(httpd.prometheus_text())
+    assert series[("t_rt_escape", (("path", 'a"b\\c'),))] == 1
+
+
+def test_parse_prometheus_rejects_malformed():
+    from combblas_tpu.obs import httpd
+
+    with pytest.raises(ValueError):          # sample without # TYPE
+        httpd.parse_prometheus("orphan_metric 1\n")
+    with pytest.raises(ValueError):          # duplicate series
+        httpd.parse_prometheus("# TYPE d counter\nd 1\nd 2\n")
+
+
+# ---------------------------------------------------------------------------
+# timeline: occupancy interval math + the unaccounted split
+# ---------------------------------------------------------------------------
+
+def test_occupancy_unions_overlapping_dispatches(obs_on):
+    from combblas_tpu.obs import ledger, timeline
+
+    led = ledger.Ledger(capacity=16)
+    for t0, wall in [(1.0, 0.5), (1.25, 0.5), (3.0, 0.25)]:
+        ledger.record("x", "dispatch", t0, wall, ledger=led)
+    o = timeline.occupancy(t0=1.0, t1=4.0, ledger=led)
+    # [1.0,1.75) u [3.0,3.25) = 1.0s busy of a 3.0s window
+    assert o["window_s"] == pytest.approx(3.0)
+    assert o["busy_s"] == pytest.approx(1.0)
+    assert o["busy_fraction"] == pytest.approx(1.0 / 3.0)
+    assert o["dispatches"] == 3
+    assert timeline.coverage(1.0, 4.0, ledger=led) == \
+        pytest.approx(1.0 / 3.0)
+
+
+def test_split_unaccounted_glue_vs_idle(obs_on):
+    """A category-less span half-covered by a ledger record splits its
+    residual into dispatch glue (overlapped) and host idle (not)."""
+    from combblas_tpu.obs import ledger, timeline
+
+    with trace.span("glue_region"):
+        with ledger.readback("t.fetch"):
+            time.sleep(0.06)
+        time.sleep(0.06)
+    split = timeline.split_unaccounted()
+    assert split["dispatch_glue_s"] >= 0.05
+    assert split["host_idle_s"] >= 0.05
+    assert split["unaccounted_s"] == pytest.approx(
+        split["dispatch_glue_s"] + split["host_idle_s"])
+    ledger.reset()
+
+
+def test_dispatch_summary_block_shape(obs_on):
+    from combblas_tpu.obs import ledger
+
+    ledger.reset()
+    ledger.record("a", "dispatch", 0.0, 0.2, compiled=True)
+    ledger.record("a", "dispatch", 0.0, 0.1)
+    ledger.record("b", "readback", 0.0, 0.05, out_bytes=64)
+    s = export.dispatch_summary(k=5)
+    assert s["dispatches"] == 2 and s["readbacks"] == 1
+    assert s["compiles"] == 1
+    assert s["recorded"] == 3 and s["dropped"] == 0
+    assert s["top"][0]["name"] == "a"
+    json.dumps(s)                           # artifact-embeddable
+    ledger.reset()
+
+
+def test_chrome_trace_ledger_flow_events(obs_on, tmp_path):
+    from combblas_tpu.obs import ledger
+
+    ledger.reset()
+    trace.set_trace_id("t00000ab")
+    try:
+        with trace.span("req"):
+            ledger.record("exec", "dispatch", time.perf_counter(), 0.01)
+    finally:
+        trace.set_trace_id(None)
+    out = tmp_path / "tr.json"
+    export.chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e.get("pid") == 1 and e["ph"] == "X"]
+    assert xs and xs[0]["name"] == "exec"
+    assert xs[0]["args"]["trace_id"] == "t00000ab"
+    flows = [e for e in evs if e["ph"] in ("b", "e")]
+    assert len(flows) == 2
+    assert flows[0]["id"] == flows[1]["id"] == 0xab
+    ledger.reset()
+
+
+def test_chrome_trace_tolerates_foreign_trace_ids(obs_on, tmp_path):
+    # externally-minted ids (not t<hex>) must not break the exporter
+    from combblas_tpu.obs import ledger
+
+    ledger.reset()
+    trace.set_trace_id("req-42/z")
+    try:
+        ledger.record("exec", "dispatch", time.perf_counter(), 0.01)
+    finally:
+        trace.set_trace_id(None)
+    out = tmp_path / "tr2.json"
+    export.chrome_trace(str(out))
+    doc = json.loads(out.read_text())
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("b", "e")]
+    assert len(flows) == 2 and flows[0]["id"] == flows[1]["id"]
+    ledger.reset()

@@ -9,6 +9,7 @@ The 512-query soak (the ISSUE acceptance workload) is `slow`; tier-1
 proves the same >=8x bound on a 96-query workload.
 """
 
+import json
 import threading
 import time
 
@@ -610,3 +611,138 @@ def test_soak_open_loop_with_deadlines(graph):
     assert outcomes["ok"] > 0
     assert svc.stats["results"] == outcomes["ok"]
     assert svc.stats["shed"] == outcomes["shed"]
+
+
+# ---------------------------------------------------------------------------
+# observability: trace ids, live endpoints, shed reasons, high water
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def obs_serving():
+    """Arm the obs layer (spans + ledger + metrics) for one serving
+    test; restore and clear global state either way."""
+    from combblas_tpu import obs
+    was = obs.enabled()
+    obs.set_enabled(True)
+    obs.reset()
+    obs.REGISTRY.reset()
+    obs.ledger.reset()
+    yield obs
+    obs.set_enabled(was)
+    obs.reset()
+    obs.REGISTRY.reset()
+    obs.ledger.reset()
+
+
+class TestServeObservability:
+    def test_trace_id_propagates_queue_to_engine(self, graph,
+                                                 obs_serving):
+        """The trace id minted at submit() is visible on the handle,
+        listed on the executing batch's span, and stamped on the
+        ledger records that batch produced — one token correlates the
+        whole queue -> batcher -> engine path."""
+        obs = obs_serving
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        h = svc.submit_cc(0)
+        assert h.trace_id and h.trace_id.startswith("t")
+        svc.start()
+        h.result(timeout=600)
+        svc.stop()
+        batch_spans = [r for r in obs.TRACER.snapshot()
+                       if r.name == "serve.batch"]
+        assert any(h.trace_id in s.attrs.get("trace_ids", ())
+                   for s in batch_spans)
+        stamped = [r for r in obs.ledger.LEDGER.snapshot()
+                   if r.trace_id == h.trace_id]
+        assert stamped, "no ledger record carries the request trace id"
+
+    def test_live_endpoints_under_workload(self, graph, obs_serving):
+        """/metrics parses as Prometheus text, /varz is JSON with the
+        service block, /healthz is 200 — scraped over real HTTP while
+        the service serves queries."""
+        import urllib.request
+
+        obs = obs_serving
+        a, n = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        srv = svc.start_metrics_server(port=0)
+        handles = [svc.submit_cc(v) for v in (0, 1, 7, 99)]
+        svc.start()
+        for h in handles:
+            h.result(timeout=600)
+
+        def get(path):
+            with urllib.request.urlopen(srv.url + path, timeout=10) as f:
+                return f.status, f.read().decode()
+
+        code, health = get("/healthz")
+        assert code == 200 and health.strip() == "ok"
+        code, varz = get("/varz")
+        assert code == 200
+        doc = json.loads(varz)
+        assert doc["service"]["healthy"] is True
+        assert doc["service"]["stats"]["results"] == 4
+        assert doc["service"]["queue_high_water"] >= 1
+        assert doc["ledger"]["total"] >= 1
+        code, text = get("/metrics")
+        assert code == 200
+        series = obs.parse_prometheus(text)
+        names = {name for name, _ in series}
+        assert "serve_dispatches" in names
+        assert "serve_queue_high_water" in names
+        # P2/reservoir quantiles ride along as a separate gauge family
+        assert any(name == "serve_latency_s_quantile"
+                   for name, _ in series)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get("/nope")
+        assert ei.value.code == 404
+        svc.stop()
+        assert svc._metrics_server is None    # stop() tears it down
+
+    def test_shed_reasons_labelled(self, graph, obs_serving):
+        """Every loss mode lands in the serve.shed counter with its
+        reason label: queue_full (admission), deadline (DOA), and
+        predicted (pre-dispatch shed); admission refusals count in
+        stats['rejected'], not stats['shed']."""
+        obs = obs_serving
+        a, n = graph
+        cfg = ServeConfig(max_queue_depth=3, buckets=(1, 2, 4),
+                          batch_wait_s=0.0)
+        svc = serve.GraphService(a, cfg, autostart=False)
+        with pytest.raises(serve.DeadlineExceededError):
+            svc.submit_cc(3, deadline_s=-1.0)     # DOA -> deadline
+        svc._cost_est["cc"] = 1000.0
+        h = svc.submit_cc(4, deadline_s=5.0)      # -> predicted
+        ok = [svc.submit_cc(0), svc.submit_cc(1)]  # no deadline: safe
+        with pytest.raises(serve.QueueFullError):
+            svc.submit_cc(2)                      # -> queue_full
+        svc.start()
+        with pytest.raises(serve.DeadlineExceededError):
+            h.result(timeout=600)
+        for hh in ok:
+            hh.result(timeout=600)
+        svc.stop()
+        assert svc.stats["rejected"] == 2
+        assert svc.stats["shed"] == 1
+        shed = obs.REGISTRY.snapshot()["serve.shed"]
+        by_reason = {dict(s["labels"])["reason"]: s["value"]
+                     for s in shed["series"]}
+        assert by_reason == {"queue_full": 1, "deadline": 1,
+                             "predicted": 1}
+
+    def test_queue_high_water_gauge(self, graph, obs_serving):
+        """The deepest-ever queue depth survives the drain: the
+        attribute keeps its max and the gauge is scrape-visible."""
+        obs = obs_serving
+        a, _ = graph
+        svc = serve.GraphService(a, CFG, autostart=False)
+        handles = [svc.submit_cc(v) for v in range(5)]
+        assert svc.queue.high_water == 5
+        svc.start()
+        for h in handles:
+            h.result(timeout=600)
+        svc.stop()
+        assert svc.queue.high_water == 5          # drained, max kept
+        snap = obs.REGISTRY.snapshot()["serve.queue_high_water"]
+        assert max(s["value"] for s in snap["series"]) == 5
