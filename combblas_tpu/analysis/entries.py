@@ -148,6 +148,26 @@ def _spmv_fixture():
 
 
 @functools.lru_cache(maxsize=None)
+def _mcl_fixture():
+    """64-vertex col-stochastic float32 matrix on a 1x1 grid, capacity
+    deliberately off the re-pin target so the mega-step's grow branch
+    lowers (concat + sentinel fill), not the `new_cap == cap` no-op."""
+    import jax
+
+    from combblas_tpu.models import mcl as M
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as DM
+    from combblas_tpu.parallel.grid import ProcGrid
+    rng = _rng()
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    n = 64
+    d = rng.random((n, n)).astype(np.float32)
+    d[rng.random((n, n)) > 0.2] = 0
+    a = DM.from_dense(S.PLUS, grid, d, 0.0, cap=896)
+    return M.make_col_stochastic(a)
+
+
+@functools.lru_cache(maxsize=None)
 def _route_fixture():
     import jax.numpy as jnp
 
@@ -203,6 +223,17 @@ def _esc_colwindow():
 # ---------------------------------------------------------------------------
 # entries: SpMV / SpMM
 # ---------------------------------------------------------------------------
+
+@register("mcl.megastep", "fused MCL iteration tail: re-pin + inflate "
+          "(Hadamard power + column re-normalization) + chaos, one "
+          "executable with donated matrix carry")
+def _mcl_megastep():
+    from combblas_tpu.models import mcl as M
+    a = _mcl_fixture()
+    fn = lambda a: M._megastep_body(a, power=2.0,      # noqa: E731
+                                    new_cap=1024)
+    return {"fn": fn, "args": (a,)}
+
 
 @register("spmv.plus_times_f32", "distributed dense-vector SpMV")
 def _spmv():

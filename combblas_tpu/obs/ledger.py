@@ -28,6 +28,15 @@ Design constraints (all load-bearing):
 * `sync=True` wrappers block on the result (data-dependent one-element
   readback via `trace.sync`) so `wall_s` includes device wall. Only
   driver-level call sites opt in; library wrappers keep async dispatch.
+* DEFERRED READBACKS have two timestamps. An async-pipelined driver
+  enqueues a device->host copy (`copy_to_host_async`) and consumes the
+  value later; `readback_deferred()` mints a handle at ENQUEUE time and
+  the eventual `.resolve()` bracket stamps the record's `t0`/`wall_s`
+  at RESOLVE time (only the wall the host actually blocked), with the
+  enqueue stamp kept in `t_enq`. Timeline attribution therefore never
+  double-counts the in-flight window as host blocking, while
+  `timeline.deferred_readback_stats` can still report queue residency
+  (t0 - t_enq) — the overlap the deferral bought.
 """
 
 from __future__ import annotations
@@ -58,10 +67,10 @@ class DispatchRecord:
 
     __slots__ = ("seq", "name", "kind", "t0", "wall_s", "arg_shapes",
                  "arg_bytes", "out_bytes", "compiled", "path", "tid",
-                 "trace_id")
+                 "trace_id", "t_enq")
 
     def __init__(self, seq, name, kind, t0, wall_s, arg_shapes, arg_bytes,
-                 out_bytes, compiled, path, tid, trace_id):
+                 out_bytes, compiled, path, tid, trace_id, t_enq=None):
         self.seq = seq
         self.name = name
         self.kind = kind              # "dispatch" | "readback"
@@ -74,6 +83,7 @@ class DispatchRecord:
         self.path = path              # enclosing span path (tuple)
         self.tid = tid
         self.trace_id = trace_id
+        self.t_enq = t_enq            # enqueue stamp (deferred readbacks)
 
     def to_dict(self) -> dict:
         return {"seq": self.seq, "name": self.name, "kind": self.kind,
@@ -81,7 +91,8 @@ class DispatchRecord:
                 "arg_shapes": list(self.arg_shapes),
                 "arg_bytes": self.arg_bytes, "out_bytes": self.out_bytes,
                 "compiled": self.compiled, "path": list(self.path),
-                "tid": self.tid, "trace_id": self.trace_id}
+                "tid": self.tid, "trace_id": self.trace_id,
+                "t_enq": self.t_enq}
 
     def __repr__(self):
         return (f"DispatchRecord(#{self.seq} {self.name} {self.kind} "
@@ -161,7 +172,7 @@ def _trace_clean() -> bool:
 
 def record(name: str, kind: str, t0: float, wall_s: float,
            arg_shapes=(), arg_bytes=0, out_bytes=0, compiled=False,
-           ledger: Ledger | None = None) -> None:
+           ledger: Ledger | None = None, t_enq: float | None = None) -> None:
     """Low-level entry: drop one record (used by `instrument` wrappers
     and by manual sites like readback loops). No-op when disabled."""
     if not (_LEDGER_ON and _trace._ENABLED):
@@ -171,7 +182,7 @@ def record(name: str, kind: str, t0: float, wall_s: float,
     led._write(seq, DispatchRecord(
         seq, name, kind, t0, wall_s, tuple(arg_shapes), arg_bytes,
         out_bytes, compiled, _trace.current_path(),
-        threading.get_ident(), _trace.get_trace_id()))
+        threading.get_ident(), _trace.get_trace_id(), t_enq))
 
 
 @contextlib.contextmanager
@@ -189,6 +200,65 @@ def readback(name: str, out_bytes: int = 0,
     finally:
         record(name, "readback", t0, time.perf_counter() - t0,
                out_bytes=out_bytes, ledger=ledger)
+
+
+class _DeferredReadback:
+    """Handle minted by `readback_deferred` at enqueue time. Bracket the
+    eventual blocking consumption with `.resolve()`: the record lands
+    with `t0`/`wall_s` stamped at RESOLVE time (the host wall actually
+    blocked) and the enqueue stamp in `t_enq`. A handle whose value is
+    never consumed (e.g. the pipeline fell back to a capacity rung
+    because the count wasn't home) records nothing — no block happened,
+    so there is nothing to attribute."""
+
+    __slots__ = ("name", "out_bytes", "ledger", "t_enq", "_done")
+
+    def __init__(self, name, out_bytes, ledger, t_enq):
+        self.name = name
+        self.out_bytes = out_bytes
+        self.ledger = ledger
+        self.t_enq = t_enq
+        self._done = False
+
+    @contextlib.contextmanager
+    def resolve(self):
+        if self._done or not (_LEDGER_ON and _trace._ENABLED):
+            yield
+            return
+        self._done = True
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            record(self.name, "readback", t0, time.perf_counter() - t0,
+                   out_bytes=self.out_bytes, ledger=self.ledger,
+                   t_enq=self.t_enq)
+
+
+class _NoopDeferred:
+    __slots__ = ()
+    t_enq = None
+
+    @contextlib.contextmanager
+    def resolve(self):
+        yield
+
+
+_NOOP_DEFERRED = _NoopDeferred()
+
+
+def readback_deferred(name: str, out_bytes: int = 0,
+                      ledger: Ledger | None = None):
+    """Mint a deferred-readback handle at the moment an async
+    device->host copy is enqueued (`Array.copy_to_host_async()`).
+    Returns a handle whose `.resolve()` context manager brackets the
+    eventual blocking consumption. Zero overhead when disabled (a
+    shared no-op handle)."""
+    if not (_LEDGER_ON and _trace._ENABLED):
+        return _NOOP_DEFERRED
+    return _DeferredReadback(
+        name, out_bytes, ledger if ledger is not None else LEDGER,
+        time.perf_counter())
 
 
 def instrument(fn, name: str, *, kind: str = "dispatch",

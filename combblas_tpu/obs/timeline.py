@@ -6,7 +6,12 @@ explains).
 
 Everything here is pure post-processing over `trace.TRACER` records and
 `ledger.LEDGER` records — both stamp `time.perf_counter()` so their
-intervals compose directly. The only live piece is `region(...)`, which
+intervals compose directly. Deferred readbacks (records with a
+non-None `t_enq`) are attributed at RESOLVE time: their `[t0, t0 +
+wall_s)` interval is only the wall the host actually blocked, so
+occupancy / glue math never counts the enqueue->resolve flight window
+as host blocking; `deferred_readback_stats` reports that residency
+separately. The only live piece is `region(...)`, which
 brackets a code region with an `obs.span` AND a `jax.profiler.
 TraceAnnotation` carrying the same region id, so device-side profiler
 timelines (when a profiler trace is being captured) correlate back to
@@ -133,6 +138,38 @@ def coverage(t0: float, t1: float, records=None, ledger=None) -> float:
     can explain by executable name."""
     return occupancy(t0=t0, t1=t1, records=records,
                      ledger=ledger)["busy_fraction"]
+
+
+# ------------------------------------------- deferred readbacks
+
+def deferred_readback_stats(records=None, ledger=None) -> dict:
+    """Aggregate deferred readbacks (records carrying `t_enq`):
+    name -> {count, blocked_s, queue_s, mean_blocked_s}.
+
+    `blocked_s` sums resolve-time walls — the host wall the value's
+    consumption actually cost; `queue_s` sums enqueue->resolve
+    residency — the device/host overlap the deferral bought (a
+    blocking readback would have stalled the host for that long
+    instead). An async pipeline is working when `queue_s` dwarfs
+    `blocked_s`."""
+    recs = records if records is not None else \
+        (ledger if ledger is not None else _ledger.LEDGER).snapshot()
+    out: dict = {}
+    for r in recs:
+        te = getattr(r, "t_enq", None)
+        if te is None:
+            continue
+        row = out.setdefault(r.name, {"count": 0, "blocked_s": 0.0,
+                                      "queue_s": 0.0})
+        row["count"] += 1
+        row["blocked_s"] += r.wall_s
+        row["queue_s"] += max(r.t0 - te, 0.0)
+    for row in out.values():
+        row["mean_blocked_s"] = row["blocked_s"] / row["count"]
+        row["blocked_s"] = round(row["blocked_s"], 6)
+        row["queue_s"] = round(row["queue_s"], 6)
+        row["mean_blocked_s"] = round(row["mean_blocked_s"], 6)
+    return out
 
 
 # ------------------------------------------------- unaccounted split

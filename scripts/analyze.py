@@ -108,6 +108,11 @@ def self_test() -> int:
     if clean:
         failures.append("i64 check fired on an i64-free lowering")
 
+    print("fixture: bad_megastep_budget.json")
+    fs = budget.run_budgets(files=[fx / "bad_megastep_budget.json"])
+    expect("mega-step budget", {f.rule for f in fs},
+           core.SORT_COUNT, core.OP_CEILING)
+
     print("fixture: bad_retrace_expect.json")
     fs = retrace.run_retrace(expect_file=fx / "bad_retrace_expect.json")
     expect("stale compile expectation", {f.rule for f in fs},

@@ -52,6 +52,7 @@ def main():
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from combblas_tpu import obs
     from combblas_tpu.ops import pallas_kernels as pk
     from combblas_tpu.ops import semiring as S
     from combblas_tpu.ops import tile as tl
@@ -77,6 +78,13 @@ def main():
         return tl.spgemm(S.PLUS_TIMES_F32, at, at,
                          flops_cap=flops_cap, out_cap=out_cap)
 
+    # flight-recorder boundary: tile.spgemm is a library callable, not
+    # an instrumented driver site — wrap it HERE so the timed reps land
+    # in the dispatch ledger and the artifact carries a
+    # dispatch_summary block like every other bench harness (this was
+    # the only one without)
+    run_rec = obs.ledger.instrument(run, "esc.spgemm", sync=True)
+
     def hlo_passes():
         txt = jax.jit(run).lower(at).as_text()
         arities = [m.group(1).count("%") for m in
@@ -100,7 +108,7 @@ def main():
         times = []
         for _ in range(args.reps):
             t0 = time.perf_counter()
-            c = run(at)
+            c = run_rec(at)
             jax.block_until_ready(c.vals)
             times.append(time.perf_counter() - t0)
         med = float(np.median(times))
@@ -123,7 +131,14 @@ def main():
         print("# fused_pallas skipped: no TPU attached (interpret mode "
               "measures the emulator, not the kernel)", file=sys.stderr,
               flush=True)
-    recs = {name: measure(name, env) for name, env in variants}
+    obs.reset()
+    obs.ledger.reset()
+    obs.set_enabled(True)
+    try:
+        recs = {name: measure(name, env) for name, env in variants}
+    finally:
+        obs.set_enabled(False)
+    dispatches = obs.export.dispatch_summary()
     for k in ("COMBBLAS_TPU_FUSED_KEY", "COMBBLAS_TPU_PALLAS_EXPAND"):
         os.environ.pop(k, None)
 
@@ -138,6 +153,7 @@ def main():
         "after_variant": after["variant"],
         "platform": platform, "scale": args.scale,
         "flops_cap": flops_cap, "variants": recs,
+        "dispatch_summary": dispatches,
         "note": "median wall time of the full jitted ESC SpGEMM "
                 "(expand + sort + dedup + re-sort) divided by flops_cap; "
                 "every variant runs the identical tile and flops_cap, "

@@ -45,6 +45,29 @@ def planted_partition(n, nclust, seed, intra_deg=16, bg_deg=2):
     return r, c, members
 
 
+#: spans whose enclosed ledger records belong to the ITERATED loop
+#: (setup/interpret excluded) — the unit of the dispatch-count metric
+_ITER_SPANS = {"mcl_expand", "mcl_megastep", "mcl_inflate", "mcl_chaos"}
+
+
+def iter_dispatch_stats(iters):
+    """Per-iteration ledger stats for the records enclosed by the
+    iteration spans: true program dispatches, blocking readbacks, and
+    deferred (resolve-time) readbacks — the before/after surface of the
+    r06 async mega-step."""
+    recs = obs.ledger.LEDGER.snapshot()
+    inloop = [r for r in recs if any(p in _ITER_SPANS for p in r.path)]
+    d = max(iters, 1)
+    disp = sum(1 for r in inloop if r.kind == "dispatch")
+    blk = sum(1 for r in inloop
+              if r.kind == "readback" and r.t_enq is None)
+    dfr = sum(1 for r in inloop
+              if r.kind == "readback" and r.t_enq is not None)
+    return {"per_iteration": round(disp / d, 2),
+            "blocking_readbacks_per_iteration": round(blk / d, 2),
+            "deferred_readbacks_per_iteration": round(dfr / d, 2)}
+
+
 def main():
     scale = int(sys.argv[1]) if len(sys.argv) > 1 else 16
     # default output: MCL_BENCH_latest.json at the repo root — bench.py
@@ -105,8 +128,34 @@ def main():
               file=sys.stderr, flush=True)
     breakdown = obs.export.phase_breakdown()
     dispatches = obs.dispatch_summary()
+    fused_stats = iter_dispatch_stats(iters)
     print(obs.export.format_report(min_s=0.01), file=sys.stderr, flush=True)
     print(obs.ledger.format_table(), file=sys.stderr, flush=True)
+
+    # before/after dispatch counts: replay a few iterations through the
+    # r05 blocking reference loop (COMBBLAS_TPU_SYNC_WINDOWS=1 gates
+    # both the blocking window loop and the unfused repin/inflate/chaos
+    # tail) on the same graph, same warm ladder — the per-iteration
+    # ledger shape is what the async mega-step collapsed
+    sync_iters = min(iters, 3) if iters else 0
+    sync_stats = None
+    if sync_iters:
+        obs.reset()
+        obs.ledger.reset()
+        obs.set_enabled(True)
+        os.environ["COMBBLAS_TPU_SYNC_WINDOWS"] = "1"
+        try:
+            _, _, si = M.mcl(
+                a, M.MclParams(max_iters=sync_iters,
+                               phase_flop_budget=budget),
+                cap_ladder=ladder)
+        finally:
+            os.environ.pop("COMBBLAS_TPU_SYNC_WINDOWS", None)
+        sync_stats = iter_dispatch_stats(si)
+        obs.set_enabled(False)
+        print(f"# sync reference ({si} iters): "
+              f"{sync_stats['per_iteration']} dispatches/iter vs fused "
+              f"{fused_stats['per_iteration']}", file=sys.stderr, flush=True)
 
     # cluster recovery quality: fraction of same-planted-cluster vertex
     # pairs (sampled) that land in the same found cluster
@@ -130,14 +179,31 @@ def main():
         "spans": obs.export.report(),
         "metrics": obs.REGISTRY.snapshot(),
         "dispatch_summary": dispatches,
-        "note": "HipMCL loop (phased pruned SpGEMM + inflate + chaos) "
-                "on a planted-partition graph, one v5e chip through the "
-                "relay tunnel. Round 5: one CapLadder pins capacity "
-                "buckets across iterations, so iterations 2..N reuse "
-                "iteration-1 compiled kernels (recompile-free steady "
-                "state; VERDICT r4 missing #1). phase_breakdown is the "
-                "obs span category split; unaccounted_s is wall time "
-                "no categorized span claimed (dispatch/Python glue).",
+        "dispatch": {
+            **fused_stats,
+            **({"sync_per_iteration": sync_stats["per_iteration"],
+                "sync_blocking_readbacks_per_iteration":
+                    sync_stats["blocking_readbacks_per_iteration"],
+                "dispatch_drop":
+                    round(sync_stats["per_iteration"]
+                          / max(fused_stats["per_iteration"], 1e-9), 2)}
+               if sync_stats else {}),
+        },
+        "note": "HipMCL loop (phased pruned SpGEMM + fused "
+                "repin/inflate/chaos mega-step) on a planted-partition "
+                "graph. Round 6: the expansion window loop is async-"
+                "pipelined (deferred one-window-behind nnz readbacks, "
+                "device-carried placement offsets) and the iteration "
+                "tail is ONE donated-carry mega-step dispatch with a "
+                "deferred chaos readback; 'dispatch' holds per-"
+                "iteration ledger counts for the fused path vs the r05 "
+                "blocking reference (COMBBLAS_TPU_SYNC_WINDOWS=1) "
+                "replayed on the same graph — dispatch_drop is the "
+                "before/after ratio. One CapLadder still pins capacity "
+                "buckets across iterations (recompile-free steady "
+                "state). phase_breakdown is the obs span category "
+                "split; unaccounted_s is wall time no categorized span "
+                "claimed (dispatch/Python glue).",
     }
     line = json.dumps(rec)
     print(line)

@@ -15,6 +15,10 @@ Steps (each standalone, continues past failures):
      /metrics + /varz + /healthz over real HTTP, and verify the
      dispatch ledger recorded the executables. Proves the recorder
      works against THIS backend before any long step runs blind.
+  0c. (--mcl) fused-MCL smoke: two async mega-step iterations on a
+     tiny planted two-clique graph; the ledger must show the fused
+     `mcl.megastep` executable and ZERO blocking per-window nnz
+     readbacks (the r05 dispatch glue the async pipeline removed).
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -109,6 +113,65 @@ def run_obs_check(grid) -> bool:
     return ok
 
 
+def run_mcl_check(grid) -> bool:
+    """Step 0c: fused-MCL smoke — two async mega-step iterations on a
+    tiny planted graph, ledger must show the fused executables and
+    ZERO blocking per-window nnz readbacks (the r05 glue the async
+    pipeline removed)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.models import mcl as M
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as dm
+
+    step("0c. fused MCL smoke (--mcl)")
+    ok = True
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.set_enabled(True)
+    try:
+        n, bsize = 16, 8
+        d = np.zeros((n, n), np.float32)
+        d[:bsize, :bsize] = 1
+        d[bsize:, bsize:] = 1
+        np.fill_diagonal(d, 0)
+        d[bsize - 1, bsize] = d[bsize, bsize - 1] = 1
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        t0 = time.perf_counter()
+        _, ncl, iters = M.mcl(a, M.MclParams(max_iters=2))
+        dt = time.perf_counter() - t0
+        recs = obs.ledger.LEDGER.snapshot()
+        names = sorted({x.name for x in recs})
+        print(f"2-clique planted graph: {ncl} cluster(s), {iters} "
+              f"iteration(s), {dt:.2f}s; ledger names: {names}")
+        if iters != 2:
+            print(f"FAIL: expected 2 fused iterations, ran {iters}")
+            ok = False
+        if not any(nm == "mcl.megastep" for nm in names):
+            print("FAIL: no mcl.megastep dispatch — the fused tail "
+                  "did not run")
+            ok = False
+        blocking = [r for r in recs
+                    if r.name == "spgemm.nnz_readback"]
+        if blocking:
+            print(f"FAIL: {len(blocking)} blocking per-window nnz "
+                  "readback(s) — the async pipeline fell back to the "
+                  "r05 loop")
+            ok = False
+        print(obs.ledger.format_table(k=8))
+        print("fused MCL:", "OK" if ok else "FAILED")
+    except Exception:
+        traceback.print_exc()
+        ok = False
+    finally:
+        obs.set_enabled(False)
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="on-chip validation + perf checklist")
@@ -119,6 +182,11 @@ def main():
     ap.add_argument("--obs", action="store_true",
                     help="flight-recorder smoke: instrumented BFS, "
                          "live /metrics scrape, ledger non-empty")
+    ap.add_argument("--mcl", action="store_true",
+                    help="fused-MCL smoke: two async mega-step "
+                         "iterations on a tiny planted graph; ledger "
+                         "must show mcl.megastep and zero blocking "
+                         "window readbacks")
     args = ap.parse_args()
     if args.analysis and not run_analysis_gate():
         sys.exit(1)
@@ -137,6 +205,8 @@ def main():
     grid = ProcGrid.make(1, 1, jax.devices()[:1])
 
     if args.obs and not run_obs_check(grid):
+        sys.exit(1)
+    if args.mcl and not run_mcl_check(grid):
         sys.exit(1)
 
     step("1. pallas scan on-chip")

@@ -233,3 +233,73 @@ def test_top_k_by_wall_and_count(obs_on):
     assert by_count[0]["mean_s"] == pytest.approx(0.001)
     table = ledger.format_table(k=2, ledger=led)
     assert "slow" in table and "fast" in table and "6 records" in table
+
+
+# ---------------------------------------------------------------------------
+# deferred readbacks (r06 async pipeline)
+# ---------------------------------------------------------------------------
+
+def test_deferred_resolve_stamps_enqueue_and_resolve(obs_on):
+    led = ledger.Ledger(capacity=64)
+    h = ledger.readback_deferred("test.deferred", out_bytes=8, ledger=led)
+    time.sleep(0.01)
+    with h.resolve():
+        pass
+    (rec,) = led.snapshot()
+    assert rec.kind == "readback"
+    assert rec.name == "test.deferred"
+    assert rec.out_bytes == 8
+    # t0 is stamped at RESOLVE time, t_enq at enqueue: the queue
+    # residency is the sleep between them
+    assert rec.t_enq is not None
+    assert rec.t0 - rec.t_enq >= 0.009
+
+
+def test_deferred_resolves_at_most_once(obs_on):
+    led = ledger.Ledger(capacity=64)
+    h = ledger.readback_deferred("test.once", ledger=led)
+    with h.resolve():
+        pass
+    with h.resolve():
+        pass
+    assert len(led.snapshot()) == 1
+
+
+def test_deferred_unresolved_records_nothing(obs_on):
+    # a handle whose value is never consumed (pipeline fell back to a
+    # capacity rung) must leave no record — no block happened
+    led = ledger.Ledger(capacity=64)
+    ledger.readback_deferred("test.dropped", ledger=led)
+    assert led.snapshot() == []
+
+
+def test_deferred_disabled_is_shared_noop():
+    was = trace.enabled()
+    trace.set_enabled(False)
+    try:
+        h = ledger.readback_deferred("test.off")
+        assert h is ledger._NOOP_DEFERRED
+        with h.resolve():
+            pass
+    finally:
+        trace.set_enabled(was)
+
+
+def test_deferred_stats_aggregation(obs_on):
+    from combblas_tpu.obs import timeline
+    led = ledger.Ledger(capacity=64)
+    h = ledger.readback_deferred("test.agg", out_bytes=4, ledger=led)
+    time.sleep(0.005)
+    with h.resolve():
+        time.sleep(0.002)
+    # a BLOCKING readback (no t_enq) must not contaminate the deferred
+    # aggregation
+    with ledger.readback("test.blocking", ledger=led):
+        pass
+    st = timeline.deferred_readback_stats(ledger=led)
+    assert set(st) == {"test.agg"}
+    row = st["test.agg"]
+    assert row["count"] == 1
+    assert row["queue_s"] >= 0.004
+    assert row["blocked_s"] >= 0.001
+    assert row["mean_blocked_s"] == pytest.approx(row["blocked_s"])

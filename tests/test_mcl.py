@@ -205,3 +205,100 @@ def test_ledger_attribution_covers_expand_wall(rng, grid):
         obs.set_enabled(was)
         obs.reset()
         obs.ledger.reset()
+
+
+# ---------------------------------------------------------------------------
+# r06 fused mega-step
+# ---------------------------------------------------------------------------
+
+class TestFusedMegastep:
+    """The async fused iteration tail (repin + inflate + deferred
+    chaos in ONE donated dispatch) must be bit-exact vs the r05
+    unfused reference (COMBBLAS_TPU_SYNC_WINDOWS=1 opt-out gates both
+    the blocking window loop and the unfused tail)."""
+
+    def test_fused_matches_unfused_reference(self, rng, grid,
+                                             monkeypatch):
+        d, n = _planted(rng)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "1")
+        ls, ncs, its = M.mcl(a, M.MclParams(max_iters=25))
+        monkeypatch.delenv("COMBBLAS_TPU_SYNC_WINDOWS")
+        lf, ncf, itf = M.mcl(a, M.MclParams(max_iters=25))
+        assert (ncf, itf) == (ncs, its)
+        np.testing.assert_array_equal(np.asarray(ls.to_global()),
+                                      np.asarray(lf.to_global()))
+
+    def test_max_iters_zero_and_one(self, rng, grid):
+        # loop-head deferred-chaos resolve: 0 iterations never enters
+        # the loop; 1 iteration exits via max_iters with a pending
+        # chaos handle that must be drained post-loop
+        d, n = _planted(rng)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        for mi in (0, 1):
+            _, _, iters = M.mcl(a, M.MclParams(max_iters=mi))
+            assert iters == mi
+
+
+class TestCapRepin:
+    """S1 regression: a growth re-pin must route through the run's
+    CapLadder so the window planner sees the minted rung (the pre-r06
+    bug computed a bare bucket and left the ladder stale)."""
+
+    def test_growth_then_shrink_trajectory(self):
+        from combblas_tpu.parallel import spgemm as spg
+        ladder = spg.CapLadder()
+        pins = []
+        cap_pin = None
+        for mx in (1000, 5000, 3000):
+            cap_pin = M._update_cap_pin(cap_pin, mx, ladder)
+            pins.append(cap_pin)
+            assert cap_pin >= mx * 5 // 4
+            assert cap_pin in ladder.rungs, (
+                f"re-pin for nnz={mx} bypassed the ladder: cap "
+                f"{cap_pin} not in rungs {sorted(ladder.rungs)}")
+        assert pins[1] > pins[0]          # growth re-pinned upward
+        assert pins[2] == pins[1]         # shrink keeps the pin sticky
+
+    def test_growth_reuses_rung_within_slack(self):
+        from combblas_tpu.parallel import spgemm as spg
+        ladder = spg.CapLadder(slack=8.0, floor=128)
+        big = ladder.fit(10000, 128)      # rung minted by the window
+        nrungs = len(ladder.rungs)        # planner earlier in the run
+        # a growth re-pin within slack of that rung must HIT it, not
+        # mint a fresh compile shape
+        pin = M._update_cap_pin(None, 1000, ladder)
+        assert pin == big
+        assert len(ladder.rungs) == nrungs
+
+
+class TestChaosNanSafety:
+    """S2: chaos() must stay finite when pruning empties columns
+    (colmax = identity = -inf or 0-sum columns used to propagate
+    NaN/inf through the convergence scalar)."""
+
+    def test_empty_matrix_chaos_zero(self, grid):
+        z = dm.from_dense(S.PLUS, grid,
+                          np.zeros((12, 12), np.float32), 0.0)
+        c = M.chaos(z)
+        assert np.isfinite(c)
+        assert c == pytest.approx(0.0, abs=1e-6)
+
+    def test_partially_empty_columns_finite(self, grid):
+        d = np.zeros((12, 12), np.float32)
+        d[0, 0] = 1.0                      # one attractor column; the
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)   # rest all-pruned
+        c = M.chaos(a)
+        assert np.isfinite(c)
+        assert c == pytest.approx(0.0, abs=1e-6)
+
+    def test_mcl_converges_on_disconnected_singletons(self, grid):
+        # isolated vertices produce empty columns inside the loop —
+        # the run must terminate by chaos, not spin on NaN
+        d = np.zeros((16, 16), np.float32)
+        d[:4, :4] = 1.0
+        np.fill_diagonal(d, 0)
+        a = dm.from_dense(S.PLUS, grid, d, 0.0)
+        _, ncl, iters = M.mcl(a, M.MclParams(max_iters=30))
+        assert iters < 30
+        assert ncl >= 1

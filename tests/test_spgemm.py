@@ -283,3 +283,112 @@ def _prune_small(c):
 
 def _below_02(v):
     return v < 0.2
+
+
+class TestPhased1x1Async:
+    """The async-pipelined window loop (r06) must be bit-exact vs the
+    r05 blocking reference (COMBBLAS_TPU_SYNC_WINDOWS=1 opt-out) across
+    semirings and edge shapes, and steady-state async windows must
+    issue ZERO blocking per-window host syncs (ledger pin)."""
+
+    @pytest.fixture(scope="class")
+    def grid11(self):
+        return ProcGrid.make(1, 1, jax.devices()[:1])
+
+    def _both(self, monkeypatch, sr, a, b, **kw):
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "1")
+        cs = SPG.spgemm_phased(sr, a, b, **kw)
+        monkeypatch.delenv("COMBBLAS_TPU_SYNC_WINDOWS")
+        ca = SPG.spgemm_phased(sr, a, b, **kw)
+        return cs, ca
+
+    @pytest.mark.parametrize("srname", ["PLUS_TIMES_F32", "MIN_PLUS_F32",
+                                        "BOOL_OR_AND"])
+    def test_bitexact_vs_sync_semirings(self, rng, grid11, monkeypatch,
+                                        srname):
+        sr = getattr(S, srname)
+        n = 24
+        da = random_sparse(rng, n, n, 0.4)
+        db = random_sparse(rng, n, n, 0.4)
+        if srname == "MIN_PLUS_F32":
+            da[da == 0] = np.inf
+            db[db == 0] = np.inf
+            add, zero = S.MIN, np.inf
+        elif srname == "BOOL_OR_AND":
+            da, db = da != 0, db != 0
+            add, zero = S.LOR, False
+        else:
+            add, zero = S.PLUS, 0.0
+        a = DM.from_dense(add, grid11, da, zero)
+        b = DM.from_dense(add, grid11, db, zero)
+        for phases in (1, 3):
+            cs, ca = self._both(monkeypatch, sr, a, b, phases=phases)
+            np.testing.assert_array_equal(
+                np.asarray(DM.to_dense(cs, zero)),
+                np.asarray(DM.to_dense(ca, zero)),
+                err_msg=f"{srname} phases={phases}")
+
+    def test_single_window_fast_path(self, rng, grid11, monkeypatch):
+        # phases=1, no out_cap: the async path skips placement AND the
+        # final sort — the values must still match the sync reference
+        # and the dense product exactly
+        da = random_sparse(rng, 16, 16, 0.5)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        cs, ca = self._both(monkeypatch, S.PLUS_TIMES_F32, a, a, phases=1)
+        np.testing.assert_array_equal(
+            np.asarray(DM.to_dense(cs, 0.0)),
+            np.asarray(DM.to_dense(ca, 0.0)))
+        np.testing.assert_allclose(DM.to_dense(ca, 0.0), da @ da,
+                                   rtol=1e-5)
+
+    def test_empty_product(self, grid11, monkeypatch):
+        z = DM.from_dense(S.PLUS, grid11,
+                          np.zeros((8, 8), np.float32), 0.0)
+        for phases in (1, 2):
+            cs, ca = self._both(monkeypatch, S.PLUS_TIMES_F32, z, z,
+                                phases=phases)
+            assert np.asarray(DM.to_dense(ca, 0.0)).sum() == 0
+            np.testing.assert_array_equal(
+                np.asarray(DM.to_dense(cs, 0.0)),
+                np.asarray(DM.to_dense(ca, 0.0)))
+
+    def test_out_cap_and_prune_hook(self, rng, grid11, monkeypatch):
+        da = random_sparse(rng, 16, 16, 0.6)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        cs, ca = self._both(monkeypatch, S.PLUS_TIMES_F32, a, a,
+                            phases=3, out_cap=512,
+                            prune_hook=_prune_small)
+        assert ca.cap == 512
+        np.testing.assert_array_equal(
+            np.asarray(DM.to_dense(cs, 0.0)),
+            np.asarray(DM.to_dense(ca, 0.0)))
+        exp = da @ da
+        exp[exp < 0.2] = 0.0
+        np.testing.assert_allclose(DM.to_dense(ca, 0.0), exp, rtol=1e-5)
+
+    def test_async_issues_zero_blocking_window_syncs(self, rng, grid11,
+                                                     monkeypatch):
+        from combblas_tpu import obs
+        da = random_sparse(rng, 24, 24, 0.5)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        was = obs.enabled()
+        obs.set_enabled(True)
+        obs.reset()
+        obs.ledger.reset()
+        try:
+            monkeypatch.delenv("COMBBLAS_TPU_SYNC_WINDOWS", raising=False)
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=3)
+            names = [r.name for r in obs.ledger.LEDGER.snapshot()]
+            assert "spgemm.nnz_readback" not in names
+            assert "spgemm.colwindow" in names
+            # the r05 opt-out is the reference: one blocking readback
+            # per window
+            obs.ledger.reset()
+            monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "1")
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=3)
+            names = [r.name for r in obs.ledger.LEDGER.snapshot()]
+            assert names.count("spgemm.nnz_readback") == 3
+        finally:
+            obs.set_enabled(was)
+            obs.reset()
+            obs.ledger.reset()
