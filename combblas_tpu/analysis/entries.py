@@ -220,6 +220,43 @@ def _esc_colwindow():
                      jnp.asarray(128, jnp.int32))}
 
 
+@register("esc.dense_window", "sort-free dense-accumulator window "
+          "variant: monoid scatter into an (nrows, win_width) buffer, "
+          "prefix-scan compaction — the budget pins ZERO sorts")
+def _esc_dense_window():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.ops import tile as T
+    a, b = _tile_pair()
+
+    def fn(a, b, clo, chi):
+        return T.spgemm_colwindow_dense(S.PLUS_TIMES_F32, a, b, clo, chi,
+                                        flops_cap=2048, out_cap=512,
+                                        win_width=40)
+    return {"fn": fn,
+            "args": (a, b, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(40, jnp.int32))}
+
+
+@register("esc.hash_window", "hash-accumulator window variant on the "
+          "XLA segment fallback (Pallas off: the default CPU lowering)")
+def _esc_hash_window():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.ops import tile as T
+    a, b = _tile_pair()
+
+    def fn(a, b, clo, chi):
+        return T.spgemm_colwindow_hash(S.PLUS_TIMES_F32, a, b, clo, chi,
+                                       flops_cap=2048, out_cap=512,
+                                       win_width=40)
+    return {"fn": fn,
+            "args": (a, b, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(40, jnp.int32))}
+
+
 # ---------------------------------------------------------------------------
 # entries: SpMV / SpMM
 # ---------------------------------------------------------------------------

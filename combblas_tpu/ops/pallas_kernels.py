@@ -388,3 +388,156 @@ def fused_expand(rowv2, deltav2, avalv2, f2, bcols, bvals, col_lo, total,
       bcols.reshape(bn, 128), bvals.reshape(bn, 128))
     return (key[:L].T.reshape(-1)[:flops_cap],
             cval[:L].T.reshape(-1)[:flops_cap])
+
+
+# ---------------------------------------------------------------------------
+# Linear-probing hash accumulator: the mid-density SpGEMM window variant
+# ---------------------------------------------------------------------------
+#
+# ESC sorts the whole |expansion|; the dense accumulator spends
+# O(nrows * win_width) memory. In between — windows whose output is a
+# few percent dense — the mtSpGEMM-style hash accumulator wins: stream
+# the expansion's (fused key, value) pairs through a VMEM-resident
+# linear-probing table (monoid combine on key collision, kmax-sentinel
+# empty slots), then sort only the table_cap-sized survivor set. The
+# sequential grid + persistent VMEM scratch make insertion order ==
+# expansion order, so floating-point combines stay bit-exact vs ESC's
+# stable-sort left-to-right order. Like the fused-expansion kernel this
+# is opt-IN on hardware until validated there:
+# COMBBLAS_TPU_PALLAS_HASH=1 opts in on TPU, =interpret forces
+# interpret mode (CPU tests), unset/0 leaves the XLA segment-reduce
+# fallback (ops.tile.spgemm_colwindow_hash) as the production default.
+
+HASH_TMAX = 1 << 16            # max table slots kept VMEM-resident
+_HASH_IB = 1024                # items per sequential grid step
+
+
+def hash_mode() -> str:
+    return os.environ.get("COMBBLAS_TPU_PALLAS_HASH", "")
+
+
+def hash_enabled() -> bool:
+    """Use the Pallas hash accumulator? Opt-IN on TPU backends (=1), or
+    anywhere under =interpret (tests); COMBBLAS_TPU_PALLAS=0 vetoes."""
+    mode = hash_mode()
+    if mode == "interpret":
+        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"
+    return mode == "1" and enabled()
+
+
+def hash_interpret() -> bool:
+    return hash_mode() == "interpret"
+
+
+def hash_table_cap(out_cap: int) -> int:
+    """Power-of-two table size >= 2 * out_cap: load factor <= 0.5 when
+    the caller's out_cap bounds the true distinct-key count (the
+    planner guarantees it), keeping probe chains short."""
+    return max(128, 1 << (2 * max(int(out_cap), 1) - 1).bit_length())
+
+
+def _hash_kernel(k_ref, v_ref, tk_out, tv_out, tk_ref, tv_ref,
+                 *, table_cap, combine, ident_val, kmax):
+    import jax.experimental.pallas as pl
+    from jax import lax
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        tk_ref[...] = jnp.full(tk_ref.shape, kmax, tk_ref.dtype)
+        tv_ref[...] = jnp.full(tv_ref.shape, ident_val, tv_ref.dtype)
+
+    mask = jnp.int32(table_cap - 1)
+    nitems = k_ref.shape[1]
+
+    def insert(j, carry):
+        k = k_ref[0, j]
+        v = v_ref[0, j]
+
+        def do():
+            # Fibonacci multiplicative hash on the fused key; int32
+            # wraparound is intentional, the mask keeps it nonnegative
+            h = (k.astype(jnp.uint32)
+                 * jnp.uint32(2654435761)).astype(jnp.int32) & mask
+
+            # the cond must stay ref-free: interpret mode discharges the
+            # loop, and while_p's discharge rule rejects ref reads in
+            # the cond — so probe state (found/empty) rides the carry
+            def cond(c):
+                _, step, done = c
+                return jnp.logical_not(done) & (step < table_cap)
+
+            def body(c):
+                slot, step, _ = c
+                tk = tk_ref[0, slot]
+                done = (tk == kmax) | (tk == k)
+                return (jnp.where(done, slot, (slot + 1) & mask),
+                        step + 1, done)
+
+            slot, _, _ = lax.while_loop(
+                cond, body, (h, jnp.int32(0), jnp.bool_(False)))
+            tk = tk_ref[0, slot]
+            # a full table (bounded probing exhausted) drops the item;
+            # callers size table_cap >= 2x the true distinct-key count
+
+            @pl.when(tk == kmax)
+            def _new():
+                tk_ref[0, slot] = k
+                tv_ref[0, slot] = v
+
+            @pl.when(tk == k)
+            def _combine():
+                tv_ref[0, slot] = combine(tv_ref[0, slot], v)
+
+        pl.when(k != kmax)(do)
+        return carry
+
+    lax.fori_loop(0, nitems, insert, jnp.int32(0))
+    tk_out[...] = tk_ref[...]
+    tv_out[...] = tv_ref[...]
+
+
+@functools.partial(jax.jit, static_argnames=("table_cap", "combine",
+                                             "ident_val", "kmax",
+                                             "interpret"))
+def hash_accumulate(key, val, *, table_cap: int, combine, ident_val,
+                    kmax: int, interpret: bool = False):
+    """Accumulate (key, val) items into a linear-probing hash table.
+
+    ``key`` (n,) int32 with dead slots carrying ``kmax``; ``val`` (n,)
+    any Mosaic-vector dtype (bool/int8 must be pre-widened to int32 by
+    the caller). Returns (table_keys, table_vals), each (table_cap,),
+    with empty slots keyed ``kmax`` and valued ``ident_val``. Items are
+    inserted in sequence order (sequential grid, persistent VMEM
+    table), so collisions combine left-to-right like ESC's stable
+    sort. ``combine``/``ident_val``/``kmax`` must be cache-stable
+    static values."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = key.shape[0]
+    nb = max(1, -(-n // _HASH_IB))
+    padN = nb * _HASH_IB
+    if padN != n:
+        key = jnp.pad(key, (0, padN - n), constant_values=kmax)
+        val = jnp.pad(val, (0, padN - n), constant_values=ident_val)
+    kernel = functools.partial(_hash_kernel, table_cap=table_cap,
+                               combine=combine, ident_val=ident_val,
+                               kmax=kmax)
+    blk = lambda: pl.BlockSpec((1, _HASH_IB), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)
+    tblk = lambda: pl.BlockSpec((1, table_cap), lambda i: (0, 0),
+                                memory_space=pltpu.VMEM)
+    tk, tv = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[blk(), blk()],
+        out_specs=[tblk(), tblk()],
+        out_shape=[_sds((1, table_cap), jnp.int32, key),
+                   _sds((1, table_cap), val.dtype, val)],
+        scratch_shapes=[pltpu.VMEM((1, table_cap), jnp.int32),
+                        pltpu.VMEM((1, table_cap), val.dtype)],
+        interpret=interpret,
+    )(key.reshape(nb, _HASH_IB), val.reshape(nb, _HASH_IB))
+    return tk.reshape(-1), tv.reshape(-1)

@@ -894,35 +894,12 @@ def _widened_multiply(multiply, a_bool: bool, b_bool: bool):
     return mult
 
 
-def _esc2_finish(sr: Semiring, a: Tile, b: Tile, per: Array, base: Array,
-                 flops_cap: int, out_cap: int, dedup: bool, *,
-                 col_lo=None, key_width: Optional[int] = None) -> Tile:
-    """Expansion + compression tail shared by every SpGEMM entry point.
-
-    ``key_width``/``col_lo`` select the window-relative fused-key codec
-    (static width, traced base — spgemm_colwindow): keys are encoded as
-    row*(width+1) + (col - col_lo), which keeps the i32 single-key path
-    reachable for column windows of matrices whose full nrows*ncols
-    exceeds 2^31. Without them the whole-tile codec is used. When no
-    key dtype fits (`fused_key_info` -> None) or COMBBLAS_TPU_FUSED_KEY=0,
-    the pre-fused reference pipeline runs instead.
-    """
-    width = b.ncols if key_width is None else key_width
-    info = (fused_key_info(a.nrows, b.ncols, width=width)
-            if fused_keys_enabled() else None)
-    if info is None:
-        crow, ccol, cval, total = _esc2_expand(sr, a, per, base, b,
-                                               flops_cap)
-        live = jnp.arange(flops_cap, dtype=jnp.int32) < total
-        crow = jnp.where(live, crow, a.nrows)
-        ccol = jnp.where(live, ccol, b.ncols)
-        t, _ = _sort_compress_2key(sr.add, crow, ccol, cval,
-                                   jnp.minimum(total, flops_cap),
-                                   nrows=a.nrows, ncols=b.ncols,
-                                   cap=out_cap, dedup=dedup)
-        return t
-    stride, kdt = info
-    clo = jnp.zeros((), jnp.int32) if col_lo is None else col_lo
+def _expand_keyed(sr: Semiring, a: Tile, b: Tile, per: Array, base: Array,
+                  flops_cap: int, *, stride: int, kdt, clo):
+    """Fused-key expansion front half shared by the ESC tail and the
+    dense/hash accumulator variants: (key, cval, total) in sequence
+    order, length flops_cap, dead slots keyed kmax. Chooses the Pallas
+    fused-expansion kernel exactly as the ESC path does."""
     rowv2, deltav2, avalv2, f2, total, L, restore = _expand_prep(
         a, per, base, flops_cap)
     from combblas_tpu.ops import pallas_kernels as pk
@@ -952,6 +929,40 @@ def _esc2_finish(sr: Semiring, a: Tile, b: Tile, per: Array, base: Array,
         key, cval = _expand_finish_xla(sr, b, rowv2, deltav2, avalv2, f2,
                                        restore, total, L, flops_cap,
                                        a.nrows, stride, kdt, clo)
+    return key, cval, total
+
+
+def _esc2_finish(sr: Semiring, a: Tile, b: Tile, per: Array, base: Array,
+                 flops_cap: int, out_cap: int, dedup: bool, *,
+                 col_lo=None, key_width: Optional[int] = None) -> Tile:
+    """Expansion + compression tail shared by every SpGEMM entry point.
+
+    ``key_width``/``col_lo`` select the window-relative fused-key codec
+    (static width, traced base — spgemm_colwindow): keys are encoded as
+    row*(width+1) + (col - col_lo), which keeps the i32 single-key path
+    reachable for column windows of matrices whose full nrows*ncols
+    exceeds 2^31. Without them the whole-tile codec is used. When no
+    key dtype fits (`fused_key_info` -> None) or COMBBLAS_TPU_FUSED_KEY=0,
+    the pre-fused reference pipeline runs instead.
+    """
+    width = b.ncols if key_width is None else key_width
+    info = (fused_key_info(a.nrows, b.ncols, width=width)
+            if fused_keys_enabled() else None)
+    if info is None:
+        crow, ccol, cval, total = _esc2_expand(sr, a, per, base, b,
+                                               flops_cap)
+        live = jnp.arange(flops_cap, dtype=jnp.int32) < total
+        crow = jnp.where(live, crow, a.nrows)
+        ccol = jnp.where(live, ccol, b.ncols)
+        t, _ = _sort_compress_2key(sr.add, crow, ccol, cval,
+                                   jnp.minimum(total, flops_cap),
+                                   nrows=a.nrows, ncols=b.ncols,
+                                   cap=out_cap, dedup=dedup)
+        return t
+    stride, kdt = info
+    clo = jnp.zeros((), jnp.int32) if col_lo is None else col_lo
+    key, cval, total = _expand_keyed(sr, a, b, per, base, flops_cap,
+                                     stride=stride, kdt=kdt, clo=clo)
     t, _ = _sort_compress_keyed(sr.add, key, cval,
                                 jnp.minimum(total, flops_cap),
                                 nrows=a.nrows, ncols=b.ncols, cap=out_cap,
@@ -1050,6 +1061,32 @@ def spgemm_rowblock(sr: Semiring, a: Tile, b: Tile, bptr: Array, elo: Array,
     return _esc2_finish(sr, blk, b, per, base, flops_cap, out_cap, dedup)
 
 
+def _window_counts(a: Tile, b: Tile, clo: Array, chi: Array, b_struct=None):
+    """Per-A-entry product count and B start offset for the column
+    window [clo, chi) — the shared front half of every column-window
+    local kernel (ESC, dense, hash). Within each B row the window's
+    entries are contiguous (the tile is (row, col)-sorted), so counts
+    and starts come from two segmented reductions over B; ``b_struct``
+    = row_structure(b) + (row_starts(b),) hoists the window-independent
+    metadata out of the per-window call."""
+    from combblas_tpu.ops.semiring import PLUS
+    v = b.valid()
+    inwin = (v & (b.cols >= clo) & (b.cols < chi)).astype(jnp.int32)
+    before = (v & (b.cols < clo)).astype(jnp.int32)
+    if b_struct is None:
+        starts_b, seg_ends, nonempty = row_structure(b)
+        bptr = row_starts(b)
+    else:
+        starts_b, seg_ends, nonempty, bptr = b_struct
+    cnt_w = seg_reduce_sorted(PLUS, inwin, starts_b, seg_ends, nonempty)
+    n_before = seg_reduce_sorted(PLUS, before, starts_b, seg_ends, nonempty)
+    bstart_w = bptr[:-1] + n_before
+    acol = jnp.clip(a.cols, 0, a.ncols - 1)
+    per = jnp.where(a.valid(), cnt_w[acol], 0)
+    base = bstart_w[acol]
+    return per, base
+
+
 @partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap", "dedup",
                                    "win_width"))
 def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
@@ -1074,21 +1111,304 @@ def spgemm_colwindow(sr: Semiring, a: Tile, b: Tile, clo: Array, chi: Array,
     """
     assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
     _flops_cap_guard(flops_cap)
-    from combblas_tpu.ops.semiring import PLUS
-    v = b.valid()
-    inwin = (v & (b.cols >= clo) & (b.cols < chi)).astype(jnp.int32)
-    before = (v & (b.cols < clo)).astype(jnp.int32)
-    if b_struct is None:
-        starts_b, seg_ends, nonempty = row_structure(b)
-        bptr = row_starts(b)
-    else:
-        starts_b, seg_ends, nonempty, bptr = b_struct
-    cnt_w = seg_reduce_sorted(PLUS, inwin, starts_b, seg_ends, nonempty)
-    n_before = seg_reduce_sorted(PLUS, before, starts_b, seg_ends, nonempty)
-    bstart_w = bptr[:-1] + n_before
-    acol = jnp.clip(a.cols, 0, a.ncols - 1)
-    per = jnp.where(a.valid(), cnt_w[acol], 0)
-    base = bstart_w[acol]
+    per, base = _window_counts(a, b, clo, chi, b_struct)
     return _esc2_finish(sr, a, b, per, base, flops_cap, out_cap, dedup,
                         col_lo=clo if win_width is not None else None,
                         key_width=win_width)
+
+
+# ---------------------------------------------------------------------------
+# Density-adaptive local-kernel variants: sort-free window accumulators
+# ---------------------------------------------------------------------------
+#
+# ESC pays O(flops * log flops) sort comparisons per window regardless
+# of how compressible the expansion is. When a window's output density
+# flops / (nrows * win_width) is high (MCL's expansion intermediates),
+# a dense (nrows, win_width) accumulator costs O(flops) scatter + one
+# O(nrows * win_width) sort-free compaction — no sorts, no segmented
+# scans over the expansion (the mtSpGEMM.h accumulator-family idea,
+# arxiv/1006.2183, TPU-shaped). `spgemm_colwindow_dense` is the monoid
+# scatter variant with an MXU sub-variant (`mxu=True`) that turns
+# plus-times windows into one real dot_general; `spgemm_colwindow_hash`
+# is the mid-density linear-probing hash accumulator (Pallas kernel in
+# ops/pallas_kernels.py, XLA segment fallback otherwise). All variants
+# are bit-exact vs the ESC reference: they combine duplicates in the
+# same expansion-sequence order, keep ESC's explicit-zero structure via
+# a separate touched mask, and drop overflow in the same largest-
+# (row, col) order (compaction positions are key-ordered).
+
+#: monoid kinds the dense/hash accumulators can scatter/segment on;
+#: user monoids (kind=None) stay on the ESC reference path
+ACCUM_KINDS = ("add", "min", "max", "or", "and")
+
+
+def _monoid_scatter(kind: str, buf: Array, fi: Array, vals: Array) -> Array:
+    """One monoid-combining scatter into a flat accumulator; ``fi`` out
+    of range drops (the dead-slot convention)."""
+    upd = buf.at[fi]
+    if kind == "add":
+        return upd.add(vals, mode="drop")
+    if kind == "min":
+        return upd.min(vals, mode="drop")
+    if kind == "max":
+        return upd.max(vals, mode="drop")
+    raise AssertionError(f"no scatter op for monoid kind {kind!r}")
+
+
+def _dense_compact(vals_flat: Array, touched_flat: Array, *, stride: int,
+                   clo, out_cap: int, nrows: int, ncols: int):
+    """Sort-free compaction of a flat dense window accumulator into a
+    sorted Tile: the flat row-major index IS the (row, col) lex order,
+    so live-entry output positions are an unsegmented prefix scan (the
+    chunk-column layout — zero sorts) and the gather-out is one
+    monotone scatter. Overflow past ``out_cap`` drops the largest flat
+    indices = the largest (row, col) coordinates, identical to ESC's
+    sort-then-truncate order. Returns (tile, pre-clamp live count)."""
+    live = touched_flat > 0
+    incl = scan_inclusive(SATADD, live.astype(jnp.int32))
+    nnz_full = incl[-1]
+    pos = incl - 1                         # target slot of live entries
+    tgt = jnp.where(live & (pos < out_cap), pos, out_cap)
+    n = live.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rows = jnp.full((out_cap + 1,), nrows, jnp.int32).at[tgt].set(
+        idx // stride, mode="drop")[:out_cap]
+    cols = jnp.full((out_cap + 1,), ncols, jnp.int32).at[tgt].set(
+        jnp.asarray(clo, jnp.int32) + idx % stride, mode="drop")[:out_cap]
+    vals = jnp.zeros((out_cap + 1,), vals_flat.dtype).at[tgt].set(
+        vals_flat, mode="drop")[:out_cap]
+    nnz = jnp.minimum(nnz_full, out_cap)
+    vals = jnp.where(jnp.arange(out_cap, dtype=jnp.int32) < nnz, vals,
+                     jnp.zeros((), vals.dtype))
+    return Tile(rows, cols, vals, nnz, nrows, ncols), nnz_full
+
+
+def mxu_eligible(sr: Semiring, a_dtype, b_dtype) -> bool:
+    """True when a window's semiring lowers to a real matmul: plus-times
+    over non-bool operands (the `dense_matmul` detection predicate)."""
+    return (sr.add.kind == "add"
+            and sr.multiply in (lax.mul, jnp.multiply)
+            and jnp.dtype(a_dtype) != jnp.bool_
+            and jnp.dtype(b_dtype) != jnp.bool_)
+
+
+def densify_operand(a: Tile, dtype=None):
+    """(values, presence) dense (nrows, ncols) renders of a tile for the
+    MXU window variant. Window-independent: phased loops hoist ONE call
+    and reuse it for every dense_mxu window. Presence is a separate 0/1
+    f32 plane because the value render cannot distinguish a stored
+    explicit zero from an absent entry — and ESC keeps stored zeros."""
+    n = a.nrows * a.ncols
+    fi = jnp.where(a.valid(), a.rows * a.ncols + a.cols, n)
+    dt = a.dtype if dtype is None else dtype
+    vals = jnp.zeros((n,), dt).at[fi].set(
+        a.vals.astype(dt), mode="drop").reshape(a.nrows, a.ncols)
+    pres = jnp.zeros((n,), jnp.float32).at[fi].set(
+        1.0, mode="drop").reshape(a.nrows, a.ncols)
+    return vals, pres
+
+
+def _mxu_window(sr: Semiring, a: Tile, b: Tile, clo, chi, win_width: int,
+                a_dense, out_dtype):
+    """Dense MXU sub-variant body: densify the B window (A is hoistable),
+    one real value matmul + one presence matmul (structure: which cells
+    any product touched, counts exact in f32 below 2^24 products/cell).
+    Requires the caller to have sized flops_cap >= the window's flops
+    (the planner guarantees it): a matmul cannot replay ESC's expansion
+    truncation."""
+    k = a.ncols
+    if a_dense is None:
+        a_dense = densify_operand(a, dtype=out_dtype)
+    avals, apres = a_dense
+    wcol = b.cols - clo
+    bok = b.valid() & (wcol >= 0) & (wcol < jnp.minimum(chi - clo, win_width))
+    fib = jnp.where(bok, b.rows * win_width + wcol, k * win_width)
+    bvals = jnp.zeros((k * win_width,), avals.dtype).at[fib].set(
+        b.vals.astype(avals.dtype), mode="drop").reshape(k, win_width)
+    bpres = jnp.zeros((k * win_width,), jnp.float32).at[fib].set(
+        1.0, mode="drop").reshape(k, win_width)
+    dense = jnp.matmul(avals, bvals,
+                       precision=lax.Precision.HIGHEST).astype(out_dtype)
+    cnt = jnp.matmul(apres, bpres, precision=lax.Precision.HIGHEST)
+    return dense.reshape(-1), (cnt > 0.5).astype(jnp.int32).reshape(-1)
+
+
+@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap",
+                                   "win_width", "mxu"))
+def spgemm_colwindow_dense(sr: Semiring, a: Tile, b: Tile, clo: Array,
+                          chi: Array, *, flops_cap: int, out_cap: int,
+                          win_width: int, b_struct=None, mxu: bool = False,
+                          a_dense=None) -> Tile:
+    """`spgemm_colwindow` on a dense (nrows, win_width) accumulator —
+    ZERO sorts, zero segmented scans over the expansion (the analysis
+    budget `esc.dense_window` pins both). The expansion's fused keys
+    decode straight to buffer coordinates; duplicates combine via one
+    monoid scatter in expansion-sequence order (XLA applies scatter
+    updates in operand order, matching ESC's stable-sort combine
+    order), a separate touched mask preserves ESC's explicit-zero
+    structure, and the tail is the sort-free `_dense_compact`.
+
+    ``mxu=True`` (plus-times only, `mxu_eligible`) swaps the scatter
+    for one real matmul over densified operands; ``a_dense`` =
+    `densify_operand(a, dtype=<product dtype>)` hoists the window-
+    independent A render. Floating-point note: the matmul reassociates
+    the += reduction, so dense_mxu is bit-exact vs ESC only for
+    exactly-representable sums (integers, small-int-valued floats);
+    the scatter variant (`mxu=False`) is bit-exact always.
+    """
+    assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
+    _flops_cap_guard(flops_cap)
+    kind = sr.add.kind
+    if kind not in ACCUM_KINDS:
+        raise ValueError(
+            f"dense window accumulator needs a known monoid kind "
+            f"(one of {ACCUM_KINDS}), got {sr.add.name!r} with "
+            f"kind={kind!r}; route user monoids to the ESC path")
+    nrows = a.nrows
+    out_dtype = jax.eval_shape(
+        sr.multiply, jax.ShapeDtypeStruct((), a.dtype),
+        jax.ShapeDtypeStruct((), b.dtype)).dtype
+    if mxu:
+        if not mxu_eligible(sr, a.dtype, b.dtype):
+            raise ValueError(
+                f"mxu=True needs a plus-times semiring over non-bool "
+                f"operands, got {sr.name!r} ({a.dtype} x {b.dtype})")
+        dense, touched = _mxu_window(sr, a, b, clo, chi, win_width,
+                                     a_dense, out_dtype)
+    else:
+        info = (fused_key_info(nrows, b.ncols, width=win_width)
+                if fused_keys_enabled() else None)
+        if info is None:
+            raise ValueError(
+                f"dense window accumulator needs the window-relative "
+                f"fused-key codec (nrows={nrows}, win_width={win_width} "
+                f"found no key dtype, or COMBBLAS_TPU_FUSED_KEY=0); "
+                f"route to the ESC path")
+        stride, kdt = info
+        per, base = _window_counts(a, b, clo, chi, b_struct)
+        key, cval, total = _expand_keyed(sr, a, b, per, base, flops_cap,
+                                         stride=stride, kdt=kdt, clo=clo)
+        n = nrows * win_width
+        r = (key // stride).astype(jnp.int32)
+        w = (key % stride).astype(jnp.int32)
+        # dead slots carry kmax -> (nrows, win_width): out of range, drop
+        fi = jnp.where((r < nrows) & (w < win_width),
+                       r * win_width + w, n)
+        if kind in ("or", "and"):
+            if out_dtype != jnp.bool_:
+                raise ValueError(
+                    f"or/and dense accumulation expects bool products, "
+                    f"got {out_dtype}")
+            # bool rides an int32 carrier: or == max, and == min over 0/1
+            ident = int(bool(sr.add.identity_scalar(jnp.bool_)))
+            dense = jnp.full((n,), ident, jnp.int32)
+            dense = _monoid_scatter("max" if kind == "or" else "min",
+                                    dense, fi, cval.astype(jnp.int32))
+            dense = dense > 0
+        else:
+            dense = jnp.full((n,), sr.add.identity(out_dtype), out_dtype)
+            dense = _monoid_scatter(kind, dense, fi, cval)
+        touched = jnp.zeros((n,), jnp.int32).at[fi].max(
+            jnp.ones((flops_cap,), jnp.int32), mode="drop")
+    t, _ = _dense_compact(dense, touched, stride=win_width, clo=clo,
+                          out_cap=out_cap, nrows=nrows, ncols=b.ncols)
+    return t
+
+
+@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap",
+                                   "win_width", "pallas_mode"))
+def _spgemm_colwindow_hash_impl(sr: Semiring, a: Tile, b: Tile, clo: Array,
+                                chi: Array, *, flops_cap: int, out_cap: int,
+                                win_width: int, b_struct=None,
+                                pallas_mode: str = "off") -> Tile:
+    """`spgemm_colwindow` on a linear-probing hash accumulator keyed on
+    the fused window-relative integer key — the mtSpGEMM hybrid's
+    mid-density regime. With the Pallas kernel enabled
+    (COMBBLAS_TPU_PALLAS_HASH=1, or =interpret for CPU tests) the
+    expansion streams through a VMEM table (monoid combine on key
+    collision, kmax-sentinel empty slots) and the only sort left is the
+    table_cap-sized output compaction — |C| log |C|, not
+    |expansion| log |expansion|. When Pallas is off, an XLA
+    segment-reduce over the dense key space computes the identical
+    result (update order == expansion order on both paths, so
+    bit-exactness vs ESC holds) with the sort-free dense compaction.
+
+    Overflow contract: the Pallas table drops late INSERTIONS when the
+    distinct-key count exceeds table_cap (bounded probing) — callers
+    must size out_cap >= the true output nnz (the planner does); the
+    XLA fallback replays ESC's exact largest-coordinate drop order.
+    """
+    assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
+    _flops_cap_guard(flops_cap)
+    kind = sr.add.kind
+    if kind not in ACCUM_KINDS:
+        raise ValueError(
+            f"hash window accumulator needs a known monoid kind "
+            f"(one of {ACCUM_KINDS}), got {sr.add.name!r} with "
+            f"kind={kind!r}; route user monoids to the ESC path")
+    nrows = a.nrows
+    info = (fused_key_info(nrows, b.ncols, width=win_width)
+            if fused_keys_enabled() else None)
+    if info is None or info[1] != jnp.int32:
+        raise ValueError(
+            f"hash window accumulator needs the i32 window-relative "
+            f"key codec (nrows={nrows}, win_width={win_width}); "
+            f"route to the ESC path")
+    stride, kdt = info
+    per, base = _window_counts(a, b, clo, chi, b_struct)
+    key, cval, total = _expand_keyed(sr, a, b, per, base, flops_cap,
+                                     stride=stride, kdt=kdt, clo=clo)
+    kmax = (nrows + 1) * stride - 1
+    from combblas_tpu.ops import pallas_kernels as pk
+    table_cap = pk.hash_table_cap(out_cap)
+    if (pallas_mode != "off" and not pk.is_batched(per)
+            and table_cap <= pk.HASH_TMAX):
+        widen = cval.dtype in (jnp.bool_, jnp.int8)
+        if widen:
+            cmb, ident = _widened_combine(sr.add, cval.dtype == jnp.bool_)
+        else:
+            cmb, ident = sr.add.combine, sr.add.identity_scalar(cval.dtype)
+        tk, tv = pk.hash_accumulate(
+            key, cval.astype(jnp.int32) if widen else cval,
+            table_cap=table_cap, combine=cmb, ident_val=ident,
+            kmax=kmax, interpret=pallas_mode == "interpret")
+        if widen:
+            tv = tv.astype(cval.dtype)
+        nlive = jnp.sum(tk != kmax).astype(jnp.int32)
+        t, _ = _sort_compress_keyed(sr.add, tk, tv, nlive, nrows=nrows,
+                                    ncols=b.ncols, cap=out_cap,
+                                    dedup=False, stride=stride, col_lo=clo)
+        return t
+    # XLA fallback: one segment-reduce over the dense key space (dead
+    # slots carry kmax >= nseg and drop), then the sort-free compaction
+    nseg = nrows * stride
+    acc = sr.add.segment_reduce(cval, key, nseg)
+    cnt = jax.ops.segment_sum(jnp.ones((flops_cap,), jnp.int32), key, nseg)
+    t, _ = _dense_compact(acc, cnt, stride=stride, clo=clo,
+                          out_cap=out_cap, nrows=nrows, ncols=b.ncols)
+    return t
+
+
+def spgemm_colwindow_hash(sr: Semiring, a: Tile, b: Tile, clo: Array,
+                         chi: Array, *, flops_cap: int, out_cap: int,
+                         win_width: int, b_struct=None) -> Tile:
+    """See `_spgemm_colwindow_hash_impl`. This thin dispatcher resolves
+    COMBBLAS_TPU_PALLAS_HASH *outside* the jit and passes it as a static
+    arg: an env read inside the traced function is invisible to the jit
+    cache, so flipping the flag after a compile would silently reuse
+    the other path's executable (the trap `jax.clear_caches()` guards
+    against for COMBBLAS_TPU_FUSED_KEY — keyed away here instead)."""
+    from combblas_tpu.ops import pallas_kernels as pk
+    if pk.hash_enabled():
+        mode = "interpret" if pk.hash_interpret() else "tpu"
+    else:
+        mode = "off"
+    return _spgemm_colwindow_hash_impl(sr, a, b, clo, chi,
+                                       flops_cap=flops_cap,
+                                       out_cap=out_cap,
+                                       win_width=win_width,
+                                       b_struct=b_struct,
+                                       pallas_mode=mode)
+
+
+spgemm_colwindow_hash._cache_size = _spgemm_colwindow_hash_impl._cache_size

@@ -51,6 +51,7 @@ from jax.sharding import PartitionSpec as P
 
 from combblas_tpu import obs
 from combblas_tpu.obs import metrics as obm
+from combblas_tpu.ops import pallas_kernels as pk
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops import tile_algebra as ta
 from combblas_tpu.ops.semiring import Semiring
@@ -75,6 +76,18 @@ _M_LADDER = obm.counter("spgemm.capladder",
                         "CapLadder rung reuse — a compile-cache proxy "
                         "(kind=hit reuses a compiled shape, kind=miss "
                         "mints a new rung => likely XLA recompile)")
+_M_VARIANT = obm.counter("spgemm.variant",
+                         "windows dispatched per local-kernel variant "
+                         "(kind=esc|hash|dense|dense_mxu)")
+_M_DENSITY = obm.histogram("spgemm.window_density",
+                           "predicted per-window output density "
+                           "flops/(nrows*width) — the variant selector's "
+                           "input",
+                           bounds=(0.01, 0.02, 0.05, 0.1, 0.25, 0.5,
+                                   1.0, 2.0, 4.0, 16.0))
+_M_HUBSPLIT = obm.counter("spgemm.hub_splits",
+                          "column windows bisected because their flop "
+                          "share exceeded the hub factor x median")
 
 
 def _check_product(a: DistSpMat, b: DistSpMat):
@@ -398,15 +411,156 @@ class CapLadder:
         return lad
 
 
+LOCAL_VARIANTS = ("esc", "hash", "dense", "dense_mxu")
+
+
+def local_variant_mode() -> str:
+    """COMBBLAS_TPU_LOCAL_VARIANT = esc | hash | dense | auto (default).
+    Global selector for the per-window local SpGEMM kernel: ``esc``
+    forces the bit-exact expand-sort-compress reference everywhere,
+    ``hash``/``dense`` force that accumulator family on every window
+    it is eligible for (ineligible windows fall back to ESC), ``auto``
+    routes each window by its predicted output density. Read per call
+    so tests can flip it without re-importing."""
+    v = os.environ.get("COMBBLAS_TPU_LOCAL_VARIANT", "auto").lower()
+    if v not in ("esc", "hash", "dense", "auto"):
+        raise ValueError(
+            f"COMBBLAS_TPU_LOCAL_VARIANT={v!r}: expected one of "
+            "esc|hash|dense|auto")
+    return v
+
+
+def _env_num(name: str, default):
+    raw = os.environ.get(name, "")
+    try:
+        return type(default)(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def variant_thresholds() -> tuple[float, float]:
+    """(dense_threshold, hash_threshold) on predicted window density
+    flops/(nrows*width) — pre-dedup, so values above 1 mean guaranteed
+    collisions. Defaults: dense at 0.25 (a quarter of the dense buffer
+    is touched — scatter+sort-free compaction beats sorting the
+    expansion), hash at 1/16 (mtSpGEMM's mid-density regime)."""
+    return (_env_num("COMBBLAS_TPU_DENSE_THRESHOLD", 0.25),
+            _env_num("COMBBLAS_TPU_HASH_THRESHOLD", 1.0 / 16.0))
+
+
+def hub_split_factor() -> float:
+    """Windows whose flop count exceeds this multiple of the initial
+    plan's median are bisected at their balanced-flop midpoint
+    (COMBBLAS_TPU_HUB_SPLIT_FACTOR, default 8; <= 0 disables). One
+    hub-heavy window otherwise pads every other window's caps AND
+    poisons the density estimate the variant selector reads."""
+    return _env_num("COMBBLAS_TPU_HUB_SPLIT_FACTOR", 8.0)
+
+
+def _dense_max() -> int:
+    """Largest nrows*win_width dense accumulator (elements) the dense
+    variant (and the hash variant's XLA dense-key fallback) may
+    allocate (COMBBLAS_TPU_DENSE_MAX, default 2^26 = 256 MB of f32)."""
+    return _env_num("COMBBLAS_TPU_DENSE_MAX", 1 << 26)
+
+
+def _mxu_amax() -> int:
+    """Largest nrows*ncols A-operand densification (elements) the MXU
+    sub-variant may hoist (COMBBLAS_TPU_MXU_AMAX, default 2^24)."""
+    return _env_num("COMBBLAS_TPU_MXU_AMAX", 1 << 24)
+
+
+def mxu_float_enabled() -> bool:
+    """COMBBLAS_TPU_MXU_FLOAT=1 lets ``auto`` upgrade dense windows to
+    the MXU matmul for FLOATING outputs. Off by default: the matmul
+    reassociates the += reduction, so float results can differ from
+    ESC in the last ulp — integer products upgrade unconditionally
+    (their sums are exact), floats only on this opt-in."""
+    return os.environ.get("COMBBLAS_TPU_MXU_FLOAT", "0").lower() \
+        not in ("0", "", "false")
+
+
+@dataclasses.dataclass(frozen=True)
+class WinPlan:
+    """One column window of a phased-SpGEMM plan. Iterates/indexes as
+    the legacy (clo, chi, flops_cap, out_cap) 4-tuple so existing
+    consumers (scripts/spgemm_stream.py, tests) keep unpacking it;
+    the planner's density estimate and chosen local-kernel variant
+    ride as named fields."""
+    lo: int
+    hi: int
+    flops_cap: int
+    out_cap: int
+    flops: int = 0
+    density: float = 0.0
+    variant: str = "esc"
+
+    def __iter__(self):
+        return iter((self.lo, self.hi, self.flops_cap, self.out_cap))
+
+    def __getitem__(self, i):
+        return (self.lo, self.hi, self.flops_cap, self.out_cap)[i]
+
+    def __len__(self):
+        return 4
+
+
+def _propose_variant(density: float, mode: str,
+                     dense_thr: float, hash_thr: float) -> str:
+    """Density-only proposal (the planner has no semiring): the final
+    per-window choice is `_resolve_variants`, which downgrades
+    ineligible windows to ESC and upgrades plus-times dense windows
+    to the MXU sub-variant."""
+    if mode != "auto":
+        return mode
+    if density >= dense_thr:
+        return "dense"
+    if density >= hash_thr:
+        return "hash"
+    return "esc"
+
+
+def _split_hubs(pairs: list, cum, fac: float):
+    """Bisect hub windows at their balanced-flop midpoint until every
+    window's flops fit under fac x the INITIAL median (width-1 windows
+    — a single hub column — cannot split further). Bounded: each split
+    strictly shrinks width. Returns the new (lo, hi) list in order."""
+    def wf(lo, hi):
+        return int(cum[hi - 1] - (cum[lo - 1] if lo else 0))
+
+    if fac <= 0 or len(pairs) < 2:
+        return pairs
+    med = float(np.median([wf(lo, hi) for lo, hi in pairs]))
+    if med <= 0:
+        return pairs
+    out = []
+    stack = list(reversed(pairs))
+    while stack:
+        lo, hi = stack.pop()
+        f = wf(lo, hi)
+        if f > fac * med and hi - lo > 1:
+            base = int(cum[lo - 1]) if lo else 0
+            mid = int(np.searchsorted(cum, base + f / 2))
+            mid = min(max(mid, lo + 1), hi - 1)
+            _M_HUBSPLIT.inc()
+            stack.append((mid, hi))
+            stack.append((lo, mid))
+        else:
+            out.append((lo, hi))
+    return out
+
+
 def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
                     phases: Optional[int] = None,
                     phase_flop_budget: int = 2 ** 26,
                     cap_round: int = 4096,
                     cap_ladder: Optional[CapLadder] = None,
-                    ) -> list[tuple[int, int, int, int]]:
+                    ) -> list[WinPlan]:
     """Single-tile phase plan: ONE host fetch of each operand's
     structure, exact per-B-column flop counts, balanced-flop window
-    boundaries. Returns [(clo, chi, flops_cap, out_cap)] with caps
+    boundaries, hub-window bisection, and a per-window density estimate
+    + proposed local-kernel variant. Returns `WinPlan` rows (legacy
+    (clo, chi, flops_cap, out_cap) unpacking preserved) with caps
     bucketed so every phase shares one compiled kernel."""
     at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0], a.nnz[0, 0],
                  a.tile_m, a.tile_n)
@@ -433,10 +587,13 @@ def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
     # lands in the same cap bucket, so one compile covers the run
     bounds = sorted({int(np.searchsorted(cum, total * k / phases))
                      for k in range(1, phases)} | {0, b.tile_n})
+    pairs = [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:])
+             if hi > lo]
+    pairs = _split_hubs(pairs, cum, hub_split_factor())
+    mode = local_variant_mode()
+    dense_thr, hash_thr = variant_thresholds()
     windows = []
-    for lo, hi in zip(bounds[:-1], bounds[1:]):
-        if hi <= lo:
-            continue
+    for lo, hi in pairs:
         f = int(cum[hi - 1] - (cum[lo - 1] if lo else 0))
         if f > _SAT:
             raise ValueError(
@@ -447,8 +604,11 @@ def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
         # clamp the bucket, not the flop count: f <= _SAT always fits,
         # only the rounded-up bucket can cross the guard
         fit = cap_ladder.fit if cap_ladder is not None else _bucket_fine
-        windows.append((lo, hi, min(fit(max(f, 1), cap_round), _SAT),
-                        min(fit(oc, cap_round), _SAT)))
+        density = f / float(max(a.tile_m * (hi - lo), 1))
+        windows.append(WinPlan(
+            lo, hi, min(fit(max(f, 1), cap_round), _SAT),
+            min(fit(oc, cap_round), _SAT), flops=f, density=density,
+            variant=_propose_variant(density, mode, dense_thr, hash_thr)))
     return windows
 
 
@@ -516,20 +676,40 @@ def _grow3(dr, dc, dv, *, grow: int, nrows: int, ncols: int):
             jnp.concatenate([dv, jnp.zeros((grow,), dv.dtype)]))
 
 
+def _local_kernel(variant, sr, at, bt, clo, chi, b_struct, a_dense, *,
+                  flops_cap, out_cap, win_width):
+    """The variant-dispatched local window multiply (inside jit)."""
+    if variant == "dense" or variant == "dense_mxu":
+        return tl.spgemm_colwindow_dense(
+            sr, at, bt, clo, chi, flops_cap=flops_cap, out_cap=out_cap,
+            win_width=win_width, b_struct=b_struct,
+            mxu=variant == "dense_mxu", a_dense=a_dense)
+    if variant == "hash":
+        return tl.spgemm_colwindow_hash(
+            sr, at, bt, clo, chi, flops_cap=flops_cap, out_cap=out_cap,
+            win_width=win_width, b_struct=b_struct)
+    return tl.spgemm_colwindow(
+        sr, at, bt, clo, chi, flops_cap=flops_cap, out_cap=out_cap,
+        win_width=win_width, b_struct=b_struct)
+
+
 @partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap",
-                                   "win_width", "hook", "meta"))
-def _colwindow_hooked(sr, at, bt, clo, chi, b_struct, *, flops_cap,
-                      out_cap, win_width, hook, meta):
+                                   "win_width", "hook", "meta", "variant"))
+def _colwindow_hooked_impl(sr, at, bt, clo, chi, b_struct, a_dense=None,
+                           *, flops_cap, out_cap, win_width, hook, meta,
+                           variant="esc"):
     """Window kernel + prune hook fused under ONE jit: the async
     pipeline's per-window work is a single dispatch instead of two
     (local multiply, then an eager hook call). The hook sees the same
     full-width 1x1 DistSpMat contract as the eager path. Keyed on the
     hook OBJECT (callers like MCL build one hook per run, so iterations
-    share the trace; caps/widths key further entries as before)."""
+    share the trace; caps/widths/variant key further entries as before
+    — variant adds at most `len(LOCAL_VARIANTS)` entries per cap rung,
+    never unbounded)."""
     grid, nrows, ncols = meta
-    cp = tl.spgemm_colwindow(sr, at, bt, clo, chi, flops_cap=flops_cap,
-                             out_cap=out_cap, win_width=win_width,
-                             b_struct=b_struct)
+    cp = _local_kernel(variant, sr, at, bt, clo, chi, b_struct, a_dense,
+                       flops_cap=flops_cap, out_cap=out_cap,
+                       win_width=win_width)
     m = DistSpMat(cp.rows[None, None], cp.cols[None, None],
                   cp.vals[None, None], cp.nnz[None, None],
                   grid, nrows, ncols, cp.nrows, cp.ncols)
@@ -538,22 +718,116 @@ def _colwindow_hooked(sr, at, bt, clo, chi, b_struct, *, flops_cap,
                    m.tile_m, m.tile_n)
 
 
+def _variant_entry(fn, inner, variant):
+    """Thin closure pinning ``variant`` (and dropping kwargs the esc
+    kernel doesn't take) so each ledger name maps to a fixed local
+    kernel; forwards the underlying jit's `_cache_size` so
+    `obs.instrument`'s compile detection keeps working."""
+    if variant in ("dense", "dense_mxu"):
+        def g(sr, at, bt, clo, chi, *, flops_cap, out_cap, win_width,
+              b_struct=None, a_dense=None):
+            return fn(sr, at, bt, clo, chi, flops_cap=flops_cap,
+                      out_cap=out_cap, win_width=win_width,
+                      b_struct=b_struct, mxu=variant == "dense_mxu",
+                      a_dense=a_dense)
+    else:
+        def g(sr, at, bt, clo, chi, *, flops_cap, out_cap, win_width,
+              b_struct=None, a_dense=None):
+            return fn(sr, at, bt, clo, chi, flops_cap=flops_cap,
+                      out_cap=out_cap, win_width=win_width,
+                      b_struct=b_struct)
+    cs = getattr(inner, "_cache_size", None)
+    if cs is not None:
+        g._cache_size = cs
+    g.__name__ = f"colwindow_{variant}"
+    return g
+
+
 # flight-recorder boundaries for the 1x1 window loop: the accumulator
 # helpers dispatch async (the enclosing "place" span syncs once), the
 # window kernel and final sort sync so their ledger wall is honest.
 # The async pipeline's variants keep the same executable names but
 # never sync (no blocking wall to attribute; the final sort carries
-# the drain).
+# the drain). The local-kernel variants land under
+# `spgemm.colwindow/<variant>` — the dispatch ledger IS the variant
+# histogram (obs_residual budgets prefix-match `spgemm.colwindow`).
 _place3 = obs.instrument(_place3, "spgemm.place3")
 _shrink_tile = obs.instrument(_shrink_tile, "spgemm.shrink_tile")
 _shrink_place3 = obs.instrument(_shrink_place3, "spgemm.shrink_place3")
 _grow3 = obs.instrument(_grow3, "spgemm.grow3")
-_colwindow = obs.instrument(tl.spgemm_colwindow, "spgemm.colwindow",
-                            sync=True)
-_colwindow_async = obs.instrument(tl.spgemm_colwindow, "spgemm.colwindow")
-_colwindow_hooked = obs.instrument(_colwindow_hooked, "spgemm.colwindow")
+
+
+def _ledger_name(variant: str) -> str:
+    return ("spgemm.colwindow" if variant == "esc"
+            else f"spgemm.colwindow/{variant}")
+
+
+def _mk_kernel_table(sync: bool) -> dict:
+    table = {}
+    for v in LOCAL_VARIANTS:
+        if v == "esc":
+            entry = _variant_entry(tl.spgemm_colwindow,
+                                   tl.spgemm_colwindow, v)
+        elif v == "hash":
+            entry = _variant_entry(tl.spgemm_colwindow_hash,
+                                   tl.spgemm_colwindow_hash, v)
+        else:
+            entry = _variant_entry(tl.spgemm_colwindow_dense,
+                                   tl.spgemm_colwindow_dense, v)
+        table[v] = obs.instrument(entry, _ledger_name(v), sync=sync)
+    return table
+
+
+_LOCAL_SYNC = _mk_kernel_table(sync=True)
+_LOCAL_ASYNC = _mk_kernel_table(sync=False)
+_HOOKED = {v: obs.instrument(_colwindow_hooked_impl, _ledger_name(v))
+           for v in LOCAL_VARIANTS}
+_colwindow = _LOCAL_SYNC["esc"]
+_colwindow_async = _LOCAL_ASYNC["esc"]
+_colwindow_hooked = _HOOKED["esc"]
 _sort_compress = obs.instrument(tl.sort_compress, "spgemm.sort_compress",
                                 sync=True)
+
+
+def _resolve_variants(sr: Semiring, windows: list, win_width: int,
+                      at: tl.Tile, bt: tl.Tile) -> list[str]:
+    """Final per-window variant choice: the planner proposed by density
+    alone; here semiring/codec/memory eligibility downgrades to ESC and
+    plus-times dense windows upgrade to the MXU sub-variant. ESC is
+    always safe — every downgrade lands there."""
+    out_dtype = jax.eval_shape(
+        sr.multiply, jax.ShapeDtypeStruct((), at.dtype),
+        jax.ShapeDtypeStruct((), bt.dtype)).dtype
+    kind_ok = sr.add.kind in tl.ACCUM_KINDS
+    info = (tl.fused_key_info(at.nrows, bt.ncols, width=win_width)
+            if tl.fused_keys_enabled() else None)
+    dmax = _dense_max()
+    buf_ok = at.nrows * win_width <= dmax
+    dense_ok = (kind_ok and info is not None and buf_ok
+                and not (sr.add.kind in ("or", "and")
+                         and out_dtype != jnp.bool_))
+    # the hash Pallas table is bounded; its XLA fallback allocates the
+    # dense key space nrows*(win_width+1), so it obeys the same bound
+    hash_ok = (kind_ok and info is not None and info[1] == jnp.int32
+               and (pk.hash_enabled()
+                    or at.nrows * (win_width + 1) <= dmax))
+    mxu_ok = (tl.mxu_eligible(sr, at.dtype, bt.dtype) and buf_ok
+              and at.nrows * at.ncols <= _mxu_amax()
+              and (not jnp.issubdtype(out_dtype, jnp.floating)
+                   or mxu_float_enabled()))
+    mode = local_variant_mode()
+    out = []
+    for w in windows:
+        v = getattr(w, "variant", "esc")
+        if v == "dense":
+            if mxu_ok:
+                v = "dense_mxu"
+            elif not dense_ok:
+                v = "hash" if (mode == "auto" and hash_ok) else "esc"
+        if v == "hash" and not hash_ok:
+            v = "esc"
+        out.append(v)
+    return out
 
 
 def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
@@ -621,6 +895,22 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         # previously recomputed row_structure(b) + row_starts(b) — two
         # full passes over B's cap — inside EVERY window call
         b_struct = tl.row_structure(bt) + (tl.row_starts(bt),)
+        # density-adaptive local kernels: the planner proposed by
+        # density, the resolver applies semiring/codec/memory
+        # eligibility (always landing on ESC when in doubt)
+        variants = _resolve_variants(sr, windows, win_width, at, bt)
+        a_dense = None
+        if any(v == "dense_mxu" for v in variants):
+            # ONE window-independent A densification feeds every MXU
+            # window of the plan (and, through the jit cache, every
+            # iteration of an iterated pipeline)
+            out_dtype = jax.eval_shape(
+                sr.multiply, jax.ShapeDtypeStruct((), at.dtype),
+                jax.ShapeDtypeStruct((), bt.dtype)).dtype
+            a_dense = tl.densify_operand(at, dtype=out_dtype)
+        for w, v in zip(windows, variants):
+            _M_VARIANT.inc(kind=v)
+            _M_DENSITY.observe(w.density)
 
     def wrap(t: tl.Tile) -> DistSpMat:
         return DistSpMat(t.rows[None, None], t.cols[None, None],
@@ -630,28 +920,36 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     if sync_windows_enabled():
         return _windows_sync(sr, a, b, at, bt, windows, win_width,
                              b_struct, prune_hook, out_cap, cap_round,
-                             fit, wrap)
+                             fit, wrap, variants, a_dense)
     return _windows_async(sr, a, b, at, bt, windows, win_width,
                           b_struct, prune_hook, out_cap, cap_round,
-                          fit, wrap)
+                          fit, wrap, variants, a_dense)
 
 
 def _windows_sync(sr, a, b, at, bt, windows, win_width, b_struct,
-                  prune_hook, out_cap, cap_round, fit, wrap):
+                  prune_hook, out_cap, cap_round, fit, wrap,
+                  variants=None, a_dense=None):
     """The r05 blocking reference loop (COMBBLAS_TPU_SYNC_WINDOWS=1):
     per-window device barriers, blocking nnz readbacks, host-known
     placement offsets. Kept verbatim as the async pipeline's
-    bit-exactness oracle."""
+    bit-exactness oracle (the local kernel is variant-dispatched in
+    BOTH loops, so each variant is its own oracle pair)."""
+    if variants is None:
+        variants = ["esc"] * len(windows)
     acc = None          # (rows, cols, vals) sentinel-padded, unsorted
     nlive = 0           # host-known live prefix of acc
     for wi, (lo, hi, fc, oc) in enumerate(windows):
+        v = variants[wi]
         with obs.span("window", w=wi, lo=lo, hi=hi, flops_cap=fc,
-                      out_cap=oc) as w_:
+                      out_cap=oc, variant=v,
+                      density=round(windows[wi].density, 4)
+                      if isinstance(windows[wi], WinPlan) else 0.0) as w_:
             with obs.span("local", category="device_execute"):
-                cp = _colwindow(
+                cp = _LOCAL_SYNC[v](
                     sr, at, bt, jnp.asarray(lo, jnp.int32),
                     jnp.asarray(hi, jnp.int32), flops_cap=fc, out_cap=oc,
-                    win_width=win_width, b_struct=b_struct)
+                    win_width=win_width, b_struct=b_struct,
+                    a_dense=a_dense if v == "dense_mxu" else None)
                 obs.sync(cp.rows)
             if prune_hook is not None:
                 with obs.span("prune", category="device_execute"):
@@ -704,28 +1002,35 @@ def _windows_sync(sr, a, b, at, bt, windows, win_width, b_struct,
 
 
 def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
-                   prune_hook, out_cap, cap_round, fit, wrap):
+                   prune_hook, out_cap, cap_round, fit, wrap,
+                   variants=None, a_dense=None):
     """The async pipeline (default): see `_phased_1x1`'s docstring."""
     hook_meta = (a.grid, a.nrows, b.ncols)
+    if variants is None:
+        variants = ["esc"] * len(windows)
 
     def dispatch_window(wi, lo, hi, fc, oc):
         """Enqueue one window's kernel (+fused prune hook) and its
         deferred count copy; nothing here blocks."""
+        v = variants[wi]
+        ad = a_dense if v == "dense_mxu" else None
         with obs.span("window", w=wi, lo=lo, hi=hi, flops_cap=fc,
-                      out_cap=oc):
+                      out_cap=oc, variant=v,
+                      density=round(windows[wi].density, 4)
+                      if isinstance(windows[wi], WinPlan) else 0.0):
             with obs.span("local", category="dispatch"):
                 if prune_hook is not None:
-                    cp = _colwindow_hooked(
+                    cp = _HOOKED[v](
                         sr, at, bt, jnp.asarray(lo, jnp.int32),
-                        jnp.asarray(hi, jnp.int32), b_struct,
+                        jnp.asarray(hi, jnp.int32), b_struct, ad,
                         flops_cap=fc, out_cap=oc, win_width=win_width,
-                        hook=prune_hook, meta=hook_meta)
+                        hook=prune_hook, meta=hook_meta, variant=v)
                 else:
-                    cp = _colwindow_async(
+                    cp = _LOCAL_ASYNC[v](
                         sr, at, bt, jnp.asarray(lo, jnp.int32),
                         jnp.asarray(hi, jnp.int32), flops_cap=fc,
                         out_cap=oc, win_width=win_width,
-                        b_struct=b_struct)
+                        b_struct=b_struct, a_dense=ad)
             nnz_ref = cp.nnz
             try:
                 nnz_ref.copy_to_host_async()

@@ -108,6 +108,11 @@ def self_test() -> int:
     if clean:
         failures.append("i64 check fired on an i64-free lowering")
 
+    print("fixture: bad_dense_sort_budget.json")
+    fs = budget.run_budgets(files=[fx / "bad_dense_sort_budget.json"])
+    expect("dense zero-sort pin", {f.rule for f in fs},
+           core.SORT_COUNT, core.SORT_ARITY)
+
     print("fixture: bad_megastep_budget.json")
     fs = budget.run_budgets(files=[fx / "bad_megastep_budget.json"])
     expect("mega-step budget", {f.rule for f in fs},

@@ -19,6 +19,12 @@ Steps (each standalone, continues past failures):
      tiny planted two-clique graph; the ledger must show the fused
      `mcl.megastep` executable and ZERO blocking per-window nnz
      readbacks (the r05 dispatch glue the async pipeline removed).
+  0d. (--esc) local SpGEMM variant smoke: one tiny A*A through the
+     phased loop under EVERY COMBBLAS_TPU_LOCAL_VARIANT value
+     (esc, hash, dense, auto); every variant must agree bit-exactly
+     with the esc reference, and the forced hash/dense runs must show
+     their variant-suffixed window dispatches on the ledger — proving
+     the selector routes before any chip time is spent.
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -172,6 +178,80 @@ def run_mcl_check(grid) -> bool:
     return ok
 
 
+def run_esc_check(grid) -> bool:
+    """Step 0d: local-variant selector smoke — one tiny A*A through
+    the phased loop under every COMBBLAS_TPU_LOCAL_VARIANT value;
+    every variant must agree BIT-EXACTLY with the esc reference and
+    the forced hash/dense runs must land their variant-suffixed
+    window dispatches on the ledger."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm, spgemm as spg
+
+    step("0d. local SpGEMM variant smoke (--esc)")
+    ok = True
+    n = 1 << 8
+    r, c = generate.rmat_edges(jax.random.key(5), 8, 8)
+    a = dm.from_global_coo(S.PLUS, grid, r, c,
+                           jnp.ones_like(r, jnp.float32), n, n)
+
+    def triples(cm):
+        k = int(np.asarray(cm.nnz[0, 0]))
+        return (np.asarray(cm.rows[0, 0])[:k],
+                np.asarray(cm.cols[0, 0])[:k],
+                np.asarray(cm.vals[0, 0])[:k])
+
+    saved = os.environ.get("COMBBLAS_TPU_LOCAL_VARIANT")
+    results, ledgers = {}, {}
+    try:
+        for mode in ("esc", "hash", "dense", "auto"):
+            os.environ["COMBBLAS_TPU_LOCAL_VARIANT"] = mode
+            obs.reset()
+            obs.ledger.LEDGER.reset()
+            obs.set_enabled(True)
+            try:
+                cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2)
+                cm.vals.block_until_ready()
+                results[mode] = triples(cm)
+                ledgers[mode] = sorted(
+                    {x.name for x in obs.ledger.LEDGER.snapshot()
+                     if x.name.startswith("spgemm.colwindow")})
+            finally:
+                obs.set_enabled(False)
+                obs.reset()
+                obs.ledger.LEDGER.reset()
+            print(f"  {mode}: c_nnz={len(results[mode][0])} "
+                  f"windows={ledgers[mode]}")
+    except Exception:
+        traceback.print_exc()
+        return False
+    finally:
+        if saved is None:
+            os.environ.pop("COMBBLAS_TPU_LOCAL_VARIANT", None)
+        else:
+            os.environ["COMBBLAS_TPU_LOCAL_VARIANT"] = saved
+
+    ref = results["esc"]
+    for mode in ("hash", "dense", "auto"):
+        for got, want in zip(results[mode], ref):
+            if not np.array_equal(got, want):
+                print(f"FAIL: {mode} diverged from the esc reference")
+                ok = False
+                break
+    for mode in ("hash", "dense"):
+        want = f"spgemm.colwindow/{mode}"
+        if not any(nm.startswith(want) for nm in ledgers[mode]):
+            print(f"FAIL: forced {mode} never dispatched {want} "
+                  f"(ledger: {ledgers[mode]})")
+            ok = False
+    print("local variants:", "OK" if ok else "FAILED")
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="on-chip validation + perf checklist")
@@ -187,6 +267,11 @@ def main():
                          "iterations on a tiny planted graph; ledger "
                          "must show mcl.megastep and zero blocking "
                          "window readbacks")
+    ap.add_argument("--esc", action="store_true",
+                    help="local SpGEMM variant smoke: tiny phased A*A "
+                         "under each COMBBLAS_TPU_LOCAL_VARIANT value; "
+                         "all variants must match the esc reference "
+                         "bit-exactly")
     args = ap.parse_args()
     if args.analysis and not run_analysis_gate():
         sys.exit(1)
@@ -207,6 +292,8 @@ def main():
     if args.obs and not run_obs_check(grid):
         sys.exit(1)
     if args.mcl and not run_mcl_check(grid):
+        sys.exit(1)
+    if args.esc and not run_esc_check(grid):
         sys.exit(1)
 
     step("1. pallas scan on-chip")

@@ -1,0 +1,446 @@
+"""Density-adaptive local SpGEMM variants: bit-exact parity of the
+dense-accumulator (scatter + MXU) and hash (Pallas + XLA fallback)
+window kernels against the ESC reference, the planner's density/variant
+emission + hub splitting, the COMBBLAS_TPU_LOCAL_VARIANT selector
+through both window loops, and the no-unbounded-recompile contract."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import spgemm as SPG
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid11():
+    return ProcGrid.make(1, 1, jax.devices()[:1])
+
+
+def _below_25(v):
+    return v < 2.5
+
+
+def _drop_small(m):
+    # module-level so the hook OBJECT is stable across calls (it keys
+    # the fused `_colwindow_hooked_impl` jit cache)
+    from combblas_tpu.parallel import algebra as alg
+    return alg.prune(m, _below_25)
+
+
+def _tile(rng, n, density, dtype="f32", int_vals=True):
+    """Random n x n tile; int-valued floats keep every sum exactly
+    representable, so even the reassociating MXU matmul is bit-exact."""
+    m = rng.random((n, n)) < density
+    r, c = np.nonzero(m)
+    if dtype == "bool":
+        vals = np.ones(len(r), bool)
+        add = S.LOR
+    elif dtype == "i32":
+        vals = rng.integers(1, 5, len(r)).astype(np.int32)
+        add = S.PLUS
+    else:
+        vals = (rng.integers(1, 5, len(r)) if int_vals
+                else rng.random(len(r)) * 4).astype(np.float32)
+        add = S.PLUS
+    cap = max(64, 1 << int(np.ceil(np.log2(max(len(r), 1)))))
+    return tl.from_coo(add, jnp.asarray(r), jnp.asarray(c),
+                       jnp.asarray(vals), nrows=n, ncols=n, cap=cap)
+
+
+def _triples(t):
+    n = int(np.asarray(t.nnz))
+    return (n, np.asarray(t.rows)[:n].tolist(),
+            np.asarray(t.cols)[:n].tolist(),
+            np.asarray(t.vals)[:n].tolist())
+
+
+def _assert_tile_equal(got, ref, msg=""):
+    assert _triples(got) == _triples(ref), msg
+
+
+SEMIRINGS = [
+    ("plus_times_f32", S.PLUS_TIMES_F32, "f32", "f32"),
+    ("plus_times_i32", S.PLUS_TIMES_I32, "i32", "i32"),
+    ("min_plus", S.MIN_PLUS_F32, "f32", "f32"),
+    ("bool_or_and", S.BOOL_OR_AND, "bool", "bool"),
+    # mixed dtypes: bool pattern x i32 values under (max, select2nd)
+    ("select2nd_mixed", S.SELECT2ND_MAX_I32, "bool", "i32"),
+]
+
+# Tile-level parity for the exotic semirings is `slow`: the loop sweep
+# below already forces every variant against the ESC reference for all
+# five semirings inside tier-1, so the tile-level rows only add the
+# Pallas-interpret path — kept on f32/i32 there, full matrix off-gate.
+PARITY_SEMIRINGS = [
+    s if s[0].startswith("plus_times")
+    else pytest.param(*s, marks=pytest.mark.slow)
+    for s in SEMIRINGS
+]
+
+
+class TestKernelParity:
+    """tile-level: every variant kernel returns byte-identical
+    (rows, cols, vals, nnz) to `tl.spgemm_colwindow` (ESC)."""
+
+    # one shared (flops_cap, out_cap, win_width) key: every parity test
+    # below reuses these compiled kernels (clo/chi are traced args, so a
+    # partial, full, or empty window is the SAME executable)
+    KW = dict(flops_cap=1 << 14, out_cap=1 << 10, win_width=16)
+
+    @pytest.mark.parametrize("name,sr,adt,bdt", PARITY_SEMIRINGS,
+                             ids=[s[0] for s in SEMIRINGS])
+    def test_dense_and_hash_match_esc(self, rng, name, sr, adt, bdt,
+                                      monkeypatch):
+        n = 32
+        a = _tile(rng, n, 0.35, adt)
+        b = _tile(rng, n, 0.35, bdt)
+        kw = self.KW
+        clo, chi = jnp.int32(4), jnp.int32(20)
+        esc = tl.spgemm_colwindow(sr, a, b, clo, chi, **kw)
+        dn = tl.spgemm_colwindow_dense(sr, a, b, clo, chi, **kw)
+        _assert_tile_equal(dn, esc, f"{name} dense != esc")
+        monkeypatch.delenv("COMBBLAS_TPU_PALLAS_HASH", raising=False)
+        hx = tl.spgemm_colwindow_hash(sr, a, b, clo, chi, **kw)
+        _assert_tile_equal(hx, esc, f"{name} hash(xla) != esc")
+        monkeypatch.setenv("COMBBLAS_TPU_PALLAS_HASH", "interpret")
+        hp = tl.spgemm_colwindow_hash(sr, a, b, clo, chi, **kw)
+        _assert_tile_equal(hp, esc, f"{name} hash(pallas) != esc")
+
+    @pytest.mark.parametrize("dt", ["f32", "i32"])
+    def test_dense_mxu_matches_esc(self, rng, dt):
+        # flops_cap must hold the window's full expansion: the matmul
+        # cannot replay ESC's expansion truncation (planner contract)
+        n = 32
+        a = _tile(rng, n, 0.35, dt)
+        b = _tile(rng, n, 0.35, dt)
+        sr = S.PLUS_TIMES_F32 if dt == "f32" else S.PLUS_TIMES_I32
+        kw = self.KW
+        clo, chi = jnp.int32(4), jnp.int32(20)
+        esc = tl.spgemm_colwindow(sr, a, b, clo, chi, **kw)
+        mx = tl.spgemm_colwindow_dense(sr, a, b, clo, chi, mxu=True, **kw)
+        _assert_tile_equal(mx, esc, f"{dt} dense_mxu != esc")
+        # hoisted a_dense must give the same answer
+        ad = tl.densify_operand(a, dtype=esc.dtype)
+        mx2 = tl.spgemm_colwindow_dense(sr, a, b, clo, chi, mxu=True,
+                                        a_dense=ad, **kw)
+        _assert_tile_equal(mx2, esc, f"{dt} dense_mxu(a_dense) != esc")
+
+    def test_empty_window(self, rng):
+        # same shapes + KW as the parity tests: clo/chi are traced, so
+        # "empty" is a data point, not a new compile
+        a = _tile(rng, 32, 0.35)
+        b = _tile(rng, 32, 0.35)
+        kw = self.KW
+        clo = chi = jnp.int32(10)
+        esc = tl.spgemm_colwindow(S.PLUS_TIMES_F32, a, b, clo, chi, **kw)
+        assert int(np.asarray(esc.nnz)) == 0
+        for fn, extra in ((tl.spgemm_colwindow_dense, {}),
+                          (tl.spgemm_colwindow_dense, {"mxu": True}),
+                          (tl.spgemm_colwindow_hash, {})):
+            got = fn(S.PLUS_TIMES_F32, a, b, clo, chi, **kw, **extra)
+            _assert_tile_equal(got, esc, f"empty window {fn.__name__}")
+
+    def test_all_one_column_hub(self, rng):
+        """Every B entry in one column: the window is a pure hub —
+        maximum collision pressure on both accumulators."""
+        n = 32
+        a = _tile(rng, n, 0.35)
+        r = np.arange(n)
+        bt = tl.from_coo(S.PLUS, jnp.asarray(r),
+                         jnp.asarray(np.full(n, 7)),
+                         jnp.asarray(np.ones(n, np.float32)),
+                         nrows=n, ncols=n, cap=512)
+        kw = self.KW
+        clo, chi = jnp.int32(0), jnp.int32(16)
+        esc = tl.spgemm_colwindow(S.PLUS_TIMES_F32, a, bt, clo, chi, **kw)
+        dn = tl.spgemm_colwindow_dense(S.PLUS_TIMES_F32, a, bt, clo, chi,
+                                       **kw)
+        hx = tl.spgemm_colwindow_hash(S.PLUS_TIMES_F32, a, bt, clo, chi,
+                                      **kw)
+        _assert_tile_equal(dn, esc, "hub dense")
+        _assert_tile_equal(hx, esc, "hub hash")
+
+    def test_out_cap_overflow_drop_order(self, rng):
+        """out_cap smaller than the true nnz: the dense compaction and
+        the hash XLA fallback must replay ESC's drop order exactly
+        (largest (row, col) dropped first)."""
+        n = 32
+        a = _tile(rng, n, 0.45)
+        b = _tile(rng, n, 0.45)
+        kw = {**self.KW, "out_cap": 64}
+        clo, chi = jnp.int32(0), jnp.int32(16)
+        esc = tl.spgemm_colwindow(S.PLUS_TIMES_F32, a, b, clo, chi, **kw)
+        assert int(np.asarray(esc.nnz)) == 64   # genuinely overflowed
+        dn = tl.spgemm_colwindow_dense(S.PLUS_TIMES_F32, a, b, clo, chi,
+                                       **kw)
+        hx = tl.spgemm_colwindow_hash(S.PLUS_TIMES_F32, a, b, clo, chi,
+                                      **kw)
+        _assert_tile_equal(dn, esc, "overflow dense")
+        _assert_tile_equal(hx, esc, "overflow hash(xla)")
+
+    def test_ineligible_semirings_raise(self, rng):
+        a = _tile(rng, 16, 0.3)
+        kw = dict(flops_cap=256, out_cap=128, win_width=16)
+        user = S.Semiring("user_plus_times", S.Monoid("uplus", jax.lax.add,
+                                                      0, kind=None),
+                          jax.lax.mul, jnp.float32)
+        with pytest.raises(ValueError, match="monoid kind"):
+            tl.spgemm_colwindow_dense(user, a, a, jnp.int32(0),
+                                      jnp.int32(16), **kw)
+        with pytest.raises(ValueError, match="monoid kind"):
+            tl.spgemm_colwindow_hash(user, a, a, jnp.int32(0),
+                                     jnp.int32(16), **kw)
+        with pytest.raises(ValueError, match="mxu"):
+            tl.spgemm_colwindow_dense(S.MIN_PLUS_F32, a, a, jnp.int32(0),
+                                      jnp.int32(16), mxu=True, **kw)
+
+
+class TestPlanner:
+    def test_winplan_unpacks_as_legacy_tuple(self, rng, grid11):
+        da = (rng.random((24, 24)) < 0.4).astype(np.float32)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        windows = SPG.plan_colwindows(a, a, phases=3)
+        for w in windows:
+            lo, hi, fc, oc = w          # legacy 4-tuple protocol
+            assert (lo, hi, fc, oc) == (w[0], w[1], w[2], w[3])
+            assert len(w) == 4
+            assert w.flops > 0 and w.density > 0
+            assert w.variant in ("esc", "hash", "dense")
+
+    def test_variant_tracks_density(self, rng, grid11, monkeypatch):
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+        dense_thr, hash_thr = SPG.variant_thresholds()
+        for dens, want in ((0.55, "dense"), (0.02, "esc")):
+            da = (rng.random((64, 64)) < dens).astype(np.float32)
+            a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+            for w in SPG.plan_colwindows(a, a, phases=2):
+                if want == "dense":
+                    assert w.density >= dense_thr
+                else:
+                    assert w.density < hash_thr
+                assert w.variant == want, (w, dens)
+
+    def test_forced_modes(self, rng, grid11, monkeypatch):
+        da = (rng.random((32, 32)) < 0.3).astype(np.float32)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        for mode in ("esc", "hash", "dense"):
+            monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", mode)
+            assert all(w.variant == mode
+                       for w in SPG.plan_colwindows(a, a, phases=2))
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "bogus")
+        with pytest.raises(ValueError, match="LOCAL_VARIANT"):
+            SPG.plan_colwindows(a, a, phases=2)
+
+    def test_split_hubs_bisects_hub_window(self):
+        """Direct `_split_hubs` check: a window carrying one hub column
+        is bisected at the balanced-flop midpoint until the hub column
+        stands alone (width 1 cannot split further)."""
+        fcol = np.array([1, 1, 1, 100, 1, 1, 1, 1], np.int64)
+        cum = np.cumsum(fcol)
+        pairs = [(0, 4), (4, 8)]              # wf = 103, 4; median 53.5
+        out = SPG._split_hubs(pairs, cum, 1.5)
+        assert out == [(0, 3), (3, 4), (4, 8)]
+        # disabled or single-window plans pass through untouched
+        assert SPG._split_hubs(pairs, cum, 0) == pairs
+        assert SPG._split_hubs([(0, 8)], cum, 1.5) == [(0, 8)]
+
+    def test_hub_splitting_rmat_hub(self, rng, grid11, monkeypatch):
+        """R-MAT-style hub columns soak up most of the flops: windows
+        that overshoot the balanced share by more than the hub factor
+        get bisected (down to single hub columns), coverage stays
+        exact, and the split plan still multiplies correctly."""
+        n = 96
+        # background sparse + 3 hub columns fed by every row
+        d = (rng.random((n, n)) < 0.03).astype(np.float32)
+        d[:, 5] = d[:, 50] = d[:, 51] = 1.0
+        a = DM.from_dense(S.PLUS, grid11, d, 0.0)
+        monkeypatch.setenv("COMBBLAS_TPU_HUB_SPLIT_FACTOR", "0")
+        base = SPG.plan_colwindows(a, a, phases=8)
+        fac = 1.2
+        monkeypatch.setenv("COMBBLAS_TPU_HUB_SPLIT_FACTOR", str(fac))
+        split = SPG.plan_colwindows(a, a, phases=8)
+        assert len(split) > len(base)
+        med = float(np.median([w.flops for w in base]))
+        for w in split:
+            assert w.flops <= fac * med or w.hi - w.lo == 1, w
+        # coverage is preserved: windows abut and span all columns
+        assert split[0].lo == 0 and split[-1].hi == a.tile_n
+        assert all(w1.lo == w0.hi for w0, w1 in zip(split, split[1:]))
+        c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=8)
+        np.testing.assert_allclose(DM.to_dense(c, 0.0), d @ d, rtol=1e-5)
+
+
+class TestLoopIntegration:
+    """spgemm_phased under every COMBBLAS_TPU_LOCAL_VARIANT value,
+    both loops, bit-identical to the ESC + sync reference."""
+
+    def _ref(self, sr, a, b, phases, monkeypatch, **kw):
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "esc")
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "1")
+        return self._triples(SPG.spgemm_phased(sr, a, b, phases=phases,
+                                               **kw))
+
+    @staticmethod
+    def _triples(c):
+        n = int(np.asarray(c.nnz[0, 0]))
+        return (n, np.asarray(c.rows[0, 0])[:n].tolist(),
+                np.asarray(c.cols[0, 0])[:n].tolist(),
+                np.asarray(c.vals[0, 0])[:n].tolist())
+
+    @pytest.mark.parametrize("name,sr,adt,bdt", SEMIRINGS,
+                             ids=[s[0] for s in SEMIRINGS])
+    def test_all_modes_both_loops(self, rng, grid11, name, sr, adt, bdt,
+                                  monkeypatch):
+        # (n, density, phases) shared with the mxu/telemetry/remint
+        # tests below: same masks => same planner caps => the esc/dense
+        # kernel compiles are paid once for the whole class
+        n = 32
+        da = rng.random((n, n)) < 0.4
+        db = rng.random((n, n)) < 0.4
+        if adt == "bool":
+            a = DM.from_dense(S.LOR, grid11, da, False)
+        else:
+            av = np.where(da, rng.integers(1, 5, (n, n)), 0)
+            a = DM.from_dense(S.PLUS, grid11,
+                              av.astype(np.float32 if adt == "f32"
+                                        else np.int32),
+                              0.0 if adt == "f32" else 0)
+        if bdt == "bool":
+            b = DM.from_dense(S.LOR, grid11, db, False)
+        else:
+            bv = np.where(db, rng.integers(1, 5, (n, n)), 0)
+            b = DM.from_dense(S.PLUS, grid11,
+                              bv.astype(np.float32 if bdt == "f32"
+                                        else np.int32),
+                              0.0 if bdt == "f32" else 0)
+        ref = self._ref(sr, a, b, 2, monkeypatch)
+        for mode in ("esc", "hash", "dense", "auto"):
+            for sync in ("0", "1"):
+                monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", mode)
+                monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", sync)
+                c = SPG.spgemm_phased(sr, a, b, phases=2)
+                assert self._triples(c) == ref, \
+                    f"{name} mode={mode} sync={sync}"
+
+    def test_single_window_skip_placement(self, rng, grid11,
+                                          monkeypatch):
+        """phases=1 + out_cap=None takes the PR-7 skip-placement fast
+        path; every variant must return the identical tile there too."""
+        da = (rng.random((32, 32)) < 0.5).astype(np.float32) * 3.0
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        ref = self._ref(S.PLUS_TIMES_F32, a, a, 1, monkeypatch)
+        for mode in ("esc", "hash", "dense", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", mode)
+            monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+            c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=1)
+            assert self._triples(c) == ref, f"single-window {mode}"
+
+    def test_prune_hook_fused_with_variants(self, rng, grid11,
+                                            monkeypatch):
+        """The async loop fuses the prune hook into the variant kernel
+        (`_colwindow_hooked_impl`); results must match the eager sync
+        reference for every mode."""
+        da = (rng.random((32, 32)) < 0.4).astype(np.float32)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        ref = self._ref(S.PLUS_TIMES_F32, a, a, 2, monkeypatch,
+                        prune_hook=_drop_small)
+        for mode in ("esc", "hash", "dense", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", mode)
+            monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+            c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                                  prune_hook=_drop_small)
+            assert self._triples(c) == ref, f"hooked {mode}"
+
+    def test_mxu_auto_upgrade_i32_and_float_optin(self, rng, grid11,
+                                                  monkeypatch):
+        """auto upgrades dense windows to dense_mxu for integer
+        products unconditionally, for floats only under
+        COMBBLAS_TPU_MXU_FLOAT=1 — and stays bit-exact here because
+        the test values make every sum exactly representable."""
+        from combblas_tpu import obs
+        n = 32
+        for dt, env in (("i32", None), ("f32", "1")):
+            # same (n, density, phases) as telemetry/remint below: the
+            # f32 esc/dense compiles here are shared with those tests
+            dv = np.where(rng.random((n, n)) < 0.4,
+                          rng.integers(1, 4, (n, n)), 0)
+            da = dv.astype(np.int32 if dt == "i32" else np.float32)
+            zero = 0 if dt == "i32" else 0.0
+            sr = S.PLUS_TIMES_I32 if dt == "i32" else S.PLUS_TIMES_F32
+            a = DM.from_dense(S.PLUS, grid11, da, zero)
+            ref = self._ref(sr, a, a, 2, monkeypatch)
+            monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+            monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+            if env:
+                monkeypatch.setenv("COMBBLAS_TPU_MXU_FLOAT", env)
+            was = obs.enabled()
+            obs.set_enabled(True)
+            obs.ledger.reset()
+            try:
+                c = SPG.spgemm_phased(sr, a, a, phases=2)
+                names = [r.name for r in obs.ledger.LEDGER.snapshot()]
+                assert "spgemm.colwindow/dense_mxu" in names, (dt, names)
+            finally:
+                obs.set_enabled(was)
+                obs.ledger.reset()
+            assert self._triples(c) == ref, f"mxu auto {dt}"
+
+    def test_variant_telemetry(self, rng, grid11, monkeypatch):
+        """Variant mix lands in obs metrics (spgemm.variant counter,
+        spgemm.window_density histogram) and in the dispatch ledger
+        under spgemm.colwindow/<variant> names."""
+        from combblas_tpu import obs
+        from combblas_tpu.obs import metrics as obm
+        # same matrix + phases as the remint test below: whichever runs
+        # first pays the dense-kernel compile, the other cache-hits
+        da = (rng.random((32, 32)) < 0.4).astype(np.float32)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "dense")
+        was = obs.enabled()
+        obs.set_enabled(True)
+        obs.ledger.reset()
+        obm.REGISTRY.reset()
+        try:
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2)
+            snap = obm.REGISTRY.snapshot()
+            assert "spgemm.variant" in snap
+            kinds = {s["labels"].get("kind"): s["value"]
+                     for s in snap["spgemm.variant"]["series"]}
+            assert sum(kinds.values()) >= 2          # one per window
+            assert set(kinds) <= {"esc", "hash", "dense", "dense_mxu"}
+            assert "spgemm.window_density" in snap
+            names = [r.name for r in obs.ledger.LEDGER.snapshot()]
+            assert any(n.startswith("spgemm.colwindow/") for n in names)
+        finally:
+            obs.set_enabled(was)
+            obs.ledger.reset()
+            obm.REGISTRY.reset()
+
+    def test_variants_do_not_remint_compiles(self, rng, grid11,
+                                             monkeypatch):
+        """Same shapes + same CapLadder => the second run of every
+        variant mode hits the jit cache (no new kernel compiles): the
+        variant selector cannot mint unbounded recompiles."""
+        da = (rng.random((32, 32)) < 0.4).astype(np.float32)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        lad = SPG.CapLadder()
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+        caches = [tl.spgemm_colwindow, tl.spgemm_colwindow_dense,
+                  tl.spgemm_colwindow_hash]
+        for mode in ("esc", "hash", "dense", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", mode)
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                              cap_ladder=lad)
+        sizes = [f._cache_size() for f in caches]
+        rungs = sorted(lad.rungs)
+        for mode in ("esc", "hash", "dense", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", mode)
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                              cap_ladder=lad)
+        assert [f._cache_size() for f in caches] == sizes
+        assert sorted(lad.rungs) == rungs

@@ -380,7 +380,9 @@ class TestPhased1x1Async:
             SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=3)
             names = [r.name for r in obs.ledger.LEDGER.snapshot()]
             assert "spgemm.nnz_readback" not in names
-            assert "spgemm.colwindow" in names
+            # the local kernel lands under spgemm.colwindow[/variant]
+            # (the suffix records the density-adaptive variant choice)
+            assert any(n.startswith("spgemm.colwindow") for n in names)
             # the r05 opt-out is the reference: one blocking readback
             # per window
             obs.ledger.reset()
