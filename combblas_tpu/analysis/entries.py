@@ -369,3 +369,106 @@ def _route_multi():
     fn = lambda w: R.apply_route_multi(rp, w)   # noqa: E731
     return {"fn": fn, "args": (words[8],),
             "variants": {"W=16": (fn, (words[16],))}}
+
+
+# ---------------------------------------------------------------------------
+# entries: scale-out collectives (SUMMA exchange, mesh bits BFS)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def _summa_fixture():
+    """256-vertex symmetric float32 graph on the full 2x4 mesh plus
+    its SUMMA caps — the hybrid-exchange collective budgets lower the
+    whole distributed multiply."""
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as DM
+    from combblas_tpu.parallel import spgemm as SPG
+    from combblas_tpu.parallel.grid import ProcGrid
+    rng = _rng()
+    grid = ProcGrid.make(2, 4, jax.devices()[:8])
+    n = 256
+    r = rng.integers(0, n, 600).astype(np.int32)
+    c = rng.integers(0, n, 600).astype(np.int32)
+    rows = np.concatenate([r, c])
+    cols = np.concatenate([c, r])
+    a = DM.from_global_coo(S.LOR, grid, jnp.asarray(rows),
+                           jnp.asarray(cols),
+                           jnp.ones(len(rows), jnp.bool_), n, n)
+    a = a.astype(jnp.float32)
+    fc, oc = SPG.plan_spgemm(a, a)
+    return a, fc, oc
+
+
+def _summa_exchange(mode):
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import spgemm as SPG
+    a, fc, oc = _summa_fixture()
+    plan = SPG.plan_bcast(a, a, mode=mode)
+    if mode == "sparse":
+        assert any(v == "sparse" for st in plan for v in (st[0], st[2])), \
+            "sparse fixture plan degenerated to dense rungs"
+
+    def fn(a, b):
+        return SPG.summa(S.PLUS_TIMES_F32, a, b, flops_cap=fc,
+                         out_cap=oc, bcast_plan=plan)
+    return {"fn": fn, "args": (a, a)}
+
+
+@register("summa.hybrid", "distributed SUMMA with the sparse nnz-prefix "
+          "tile exchange on every eligible stage (2x4 mesh)")
+def _summa_hybrid():
+    return _summa_exchange("sparse")
+
+
+@register("summa.dense_exchange", "the same SUMMA product with every "
+          "stage forced to the dense full-capacity broadcast — its "
+          "collective ceilings must equal summa.hybrid's (the sparse "
+          "exchange changes payload shapes, never collective counts)")
+def _summa_dense_exchange():
+    return _summa_exchange("dense")
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_graph_fixture():
+    """256-vertex pattern-symmetric boolean graph on a routed 2x2
+    mesh, eligible for the multi-tile packed-bit batch path."""
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as DM
+    from combblas_tpu.parallel.grid import ProcGrid
+    rng = _rng()
+    grid = ProcGrid.make(2, 2, jax.devices()[:4])
+    n = 256
+    r = rng.integers(0, n, 600).astype(np.int32)
+    c = rng.integers(0, n, 600).astype(np.int32)
+    rows = np.concatenate([r, c])
+    cols = np.concatenate([c, r])
+    a = DM.from_global_coo(S.LOR, grid, jnp.asarray(rows),
+                           jnp.asarray(cols),
+                           jnp.ones(len(rows), jnp.bool_), n, n)
+    plan = B.plan_bfs(a, route=True)
+    assert B.bits_fallback_reason(a, plan) is None, \
+        "mesh graph fixture must be bits-eligible"
+    return a, plan
+
+
+@register("bfs.bits_mesh_core", "multi-tile packed-bit batch BFS core "
+          "on a routed 2x2 mesh: one lane-word ppermute exchange + one "
+          "all_gather per level, lane-width invariant")
+def _bfs_bits_mesh_core():
+    import jax.numpy as jnp
+
+    from combblas_tpu.models import bfs as B
+    a, plan = _mesh_graph_fixture()
+    ml = jnp.int32(1 << 30)
+    fn = lambda roots, ml: B._bfs_batch_bits_mesh_core(  # noqa: E731
+        a, plan, roots, ml)
+    return {"fn": fn,
+            "args": (jnp.zeros((8,), jnp.int32), ml),
+            "variants": {"W=16": (fn, (jnp.zeros((16,), jnp.int32), ml))}}

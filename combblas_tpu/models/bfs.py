@@ -35,6 +35,7 @@ frontier size ≤ F) *and* the Beamer-style heuristic favors it
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 
 import jax
@@ -44,6 +45,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from combblas_tpu import obs
+from combblas_tpu.obs import metrics as obm
 from combblas_tpu.ops import bitseg as bs
 from combblas_tpu.ops import generate
 from combblas_tpu.ops import route as rt
@@ -980,13 +982,39 @@ def bfs_bits(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
     return dv.DistVec(parents[None, :], a.grid, ROW_AXIS, a.nrows)
 
 
+#: why a batch fell off the 32x bits path — the labels on
+#: `bfs.bits_fallback` (metric + ledger records + serve /varz)
+BITS_FALLBACK_REASONS = ("unrouted", "asymmetric", "mesh")
+
+_M_BITS_FALLBACK = obm.counter(
+    "bfs.bits_fallback",
+    "batches that silently degraded from the packed-bit path to dense "
+    "bfs_batch (kind=unrouted|asymmetric|mesh) — each one pays ~32x "
+    "the per-root frontier traffic")
+
+
+def bits_fallback_reason(a: dm.DistSpMat,
+                         plan: BfsPlan | None) -> str | None:
+    """None when the bitplane batched BFS applies, else the reason the
+    batch will ride dense `bfs_batch`: ``unrouted`` (no plan or no
+    Beneš masks), ``asymmetric`` (1x1 grid but the col-order==row-order
+    bit identity is unverified), ``mesh`` (multi-tile grid that is not
+    a square routed mesh with square vertex blocks — the transpose
+    exchange needs (i,j)<->(j,i) pairing)."""
+    if plan is None or plan.route_masks is None:
+        return "unrouted"
+    if a.grid.pr == 1 and a.grid.pc == 1:
+        return None if plan.symmetric else "asymmetric"
+    return None if _bits_mesh_ok(a, plan) else "mesh"
+
+
 def bits_batch_ok(a: dm.DistSpMat, plan: BfsPlan | None) -> bool:
-    """Whether the bitplane batched BFS applies: single-tile grid,
-    routed plan, verified pattern symmetry (the same guards as
-    `bfs_bits` — the whole algorithm rests on the col-order==row-order
-    bit identity)."""
-    return (plan is not None and a.grid.pr == 1 and a.grid.pc == 1
-            and plan.route_masks is not None and plan.symmetric)
+    """Whether a bitplane batched BFS path applies: on a 1x1 grid the
+    single-tile core (routed plan + verified pattern symmetry, the
+    same guards as `bfs_bits`); on a multi-tile grid the mesh core
+    (`_bits_mesh_ok`: routed square mesh with column-run bits — no
+    symmetry needed there, the frontier expansion is explicit)."""
+    return bits_fallback_reason(a, plan) is None
 
 
 def bfs_batch_bits(a: dm.DistSpMat, roots, max_levels=None, plan=None):
@@ -1000,10 +1028,13 @@ def bfs_batch_bits(a: dm.DistSpMat, roots, max_levels=None, plan=None):
 
     Host-level wrapper: validates roots (any root outside [0, n) is a
     ValueError), then dispatches to the jitted bitplane core when
-    `bits_batch_ok` holds, else falls back to dense `bfs_batch`
-    (unrouted plan, pattern-asymmetric matrix, or a mesh — the exact
-    guards `bfs_bits` enforces by raising; a batch endpoint degrades
-    instead).
+    `bits_batch_ok` holds — the single-tile core on a 1x1 grid, the
+    mesh core (`_bfs_batch_bits_mesh_core`: lane-packed frontier words
+    in the explicit transpose exchange) on square routed meshes — else
+    falls back to dense `bfs_batch` (unrouted plan, pattern-asymmetric
+    1x1 matrix, or an ineligible mesh; a batch endpoint degrades
+    instead of raising, and each degradation is counted + ledgered as
+    `bfs.bits_fallback{reason}`).
 
     Returns the `bfs_batch` triple (parents r-aligned DistMultiVec,
     levels, done (W,) bool), with ``levels`` PER-LANE on the bits
@@ -1020,7 +1051,13 @@ def bfs_batch_bits(a: dm.DistSpMat, roots, max_levels=None, plan=None):
         bad = roots_np[(roots_np < 0) | (roots_np >= a.nrows)]
         raise ValueError(f"roots {bad.tolist()} outside [0, {a.nrows})")
     roots32 = jnp.asarray(roots_np, jnp.int32)
-    if not bits_batch_ok(a, plan):
+    reason = bits_fallback_reason(a, plan)
+    if reason is not None:
+        # ledger-visible degradation: fleet dashboards see the 32x
+        # economics being lost, by reason, in every dispatch_summary
+        _M_BITS_FALLBACK.inc(kind=reason)
+        obs.ledger.record(f"bfs.bits_fallback/{reason}", "dispatch",
+                          time.perf_counter(), 0.0)
         mv, lvl, done = bfs_batch(a, roots32, max_levels, plan=plan)
         return mv, jnp.broadcast_to(lvl, done.shape), done
     if plan.sig and plan.sig != (a.grid.pr, a.grid.pc, a.cap,
@@ -1034,7 +1071,9 @@ def bfs_batch_bits(a: dm.DistSpMat, roots, max_levels=None, plan=None):
     else:
         ml = jnp.asarray(max_levels, jnp.int32)
         ml = jnp.where(ml <= 0, jnp.int32(_SAT), ml)
-    return _bfs_batch_bits_core(a, plan, roots32, ml)
+    if a.grid.pr == 1 and a.grid.pc == 1:
+        return _bfs_batch_bits_core(a, plan, roots32, ml)
+    return _bfs_batch_bits_mesh_core(a, plan, roots32, ml)
 
 
 @jax.jit
@@ -1290,6 +1329,203 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
 
 
 bfs_bits_mesh = obs.instrument(bfs_bits_mesh, "bfs.bits_mesh")
+
+
+def bfs_batch_bits_mesh(a: dm.DistSpMat, roots, max_levels=None,
+                        plan: BfsPlan | None = None):
+    """Batched packed-bit BFS on a multi-tile routed mesh: the
+    32-roots-per-word bitplane machinery of `bfs_batch_bits` lifted
+    onto the explicit frontier exchange of `bfs_bits_mesh` — every
+    exchanged quantity is a lane-packed WORD matrix (one uint32 per 32
+    lanes per slot), so the per-level ppermute/all_gather volume per
+    root is 1 bit per vertex/edge slot where dense `bfs_batch` moves a
+    full i32 column. Raises on ineligible inputs (use `bfs_batch_bits`
+    for the degrading endpoint); returns the `bfs_batch` triple with
+    PER-LANE levels, exactly like the single-tile bits path."""
+    if plan is None or not _bits_mesh_ok(a, plan):
+        raise ValueError(
+            "bfs_batch_bits_mesh needs a routed plan "
+            "(plan_bfs(a, route=True)) on a square mesh with square "
+            "vertex blocks; use bfs_batch_bits (degrading) or "
+            "bfs_batch otherwise")
+    roots_np = np.asarray(roots, np.int64)
+    if roots_np.ndim != 1 or roots_np.size == 0:
+        raise ValueError("roots must be a non-empty 1-D array")
+    if roots_np.min() < 0 or roots_np.max() >= a.nrows:
+        bad = roots_np[(roots_np < 0) | (roots_np >= a.nrows)]
+        raise ValueError(f"roots {bad.tolist()} outside [0, {a.nrows})")
+    if plan.sig and plan.sig != (a.grid.pr, a.grid.pc, a.cap,
+                                 a.tile_m, a.tile_n):
+        raise ValueError(
+            f"BfsPlan signature {plan.sig} does not match matrix "
+            f"{(a.grid.pr, a.grid.pc, a.cap, a.tile_m, a.tile_n)}: the "
+            "plan was built for a different matrix")
+    roots32 = jnp.asarray(roots_np, jnp.int32)
+    if max_levels is None:
+        ml = jnp.int32(_SAT)
+    else:
+        ml = jnp.asarray(max_levels, jnp.int32)
+        ml = jnp.where(ml <= 0, jnp.int32(_SAT), ml)
+    return _bfs_batch_bits_mesh_core(a, plan, roots32, ml)
+
+
+@jax.jit
+def _bfs_batch_bits_mesh_core(a: dm.DistSpMat, plan: BfsPlan, roots, ml):
+    """The mesh bitplane wave loop (see bfs_batch_bits_mesh): the
+    level body of `bfs_bits_mesh` with every carry widened to an
+    (nwords, W) lane matrix. One while_loop iteration advances ALL W
+    roots one level on every tile:
+
+      1. `ppermute` the (nwv, W) new-frontier vertex WORDS to the
+         transpose position — 32 roots per uint32 on the wire;
+      2. lane-scatter each active column's bits at its column-run
+         start, lane-parallel segment-OR fill (`seg_or_fill_multi`);
+      3. route all W planes through the shared Beneš masks
+         (`apply_route_multi_best` — pair-kernel on TPU);
+      4. per-row reached bits per lane, OR-combined across the mesh
+         row via one packed `all_gather`;
+      5. accumulate per-lane parent-candidate edge bits.
+
+    Per-lane level counters advance only for lanes that discovered a
+    vertex anywhere on the mesh (one pmax per level); inert lanes ride
+    along as all-zero planes. Parents extract once after the loop —
+    per-lane segmented max over global column ids, pmax along the mesh
+    row — exactly the single-root extraction vmapped over lanes."""
+    from combblas_tpu.parallel import densemat as dmm
+    grid = a.grid
+    pr, pc = grid.pr, grid.pc
+    cap, tile_m, tile_n = a.cap, a.tile_m, a.tile_n
+    npad = rt.mask_npad(_mask_words(plan.route_masks), plan.route_compact)
+    nwv = -(-tile_m // 32)               # vertex-bit words per block
+    w_lanes = roots.shape[0]
+    capp = plan.cols_t.shape[-1]
+    chunk_len = capp // 128
+    tperm = [(j * pc + i, i * pc + j) for i in range(pr) for j in range(pc)]
+    lvl_cap = jnp.int32(min(pr * tile_m, _SAT))
+
+    def f(cols_t, starts_t, valid_t, ends_m, nonempty, cstarts, cdeg,
+          rmasks, sb, vb, cb, rstarts):
+        i = lax.axis_index(ROW_AXIS)
+        j = lax.axis_index(COL_AXIS)
+        cols_t, starts_t, valid_t = cols_t[0, 0], starts_t[0, 0], valid_t[0, 0]
+        ends_m, nonempty = ends_m[0, 0], nonempty[0, 0]
+        cstarts, cdeg = cstarts[0, 0], cdeg[0, 0]
+        sb, vb, cb, rstarts = sb[0, 0], vb[0, 0], cb[0, 0], rstarts[0, 0]
+        rp = rt.RoutePlan(rt.tile_masks(rmasks[0, 0]), cap, npad,
+                          plan.route_compact)
+        row_nonempty = rstarts[1:] > rstarts[:-1]
+        rs_lo = jnp.clip(rstarts[:-1], 0, npad - 1)   # (tile_m,)
+
+        inblk_l = (roots >= i * tile_m) & (roots < (i + 1) * tile_m)
+        rloc_l = jnp.clip(roots - i * tile_m, 0, tile_m - 1)
+        w_ix = jnp.arange(w_lanes, dtype=jnp.int32)
+
+        # lane seeds: root w's vertex bit in lane w of its owning row
+        # block (duplicate roots seed identical independent lanes)
+        def seed_lane(r):
+            inb = (r >= i * tile_m) & (r < (i + 1) * tile_m)
+            rl = jnp.clip(r - i * tile_m, 0, tile_m - 1)
+            s = jnp.zeros((nwv,), jnp.uint32).at[rl >> 5].set(
+                jnp.uint32(1) << (rl & 31).astype(jnp.uint32))
+            return jnp.where(inb, s, jnp.zeros_like(s))
+
+        newv0 = jax.vmap(seed_lane, out_axes=1)(roots)   # (nwv, W)
+        pcand0 = jnp.zeros((npad // 32, w_lanes), jnp.uint32)
+        lanelvl0 = jnp.zeros((w_lanes,), jnp.int32)
+
+        def extract_row_bits_multi(filled):
+            w = filled[rs_lo >> 5]                       # (tile_m, W)
+            bit = (w >> (rs_lo & 31).astype(jnp.uint32)[:, None]) \
+                & jnp.uint32(1)
+            return rt.pack_bits_multi(
+                jnp.where(row_nonempty[:, None], bit.astype(jnp.int8), 0),
+                nwv * 32)
+
+        def expand_runs_multi(vbits, n_v, run_starts, run_nonempty,
+                              run_bits):
+            v8 = rt.unpack_bits_multi(vbits, n_v)        # (n_v, W)
+            seed = jnp.zeros((cap + 1, w_lanes), jnp.int8).at[
+                jnp.where(run_nonempty, run_starts, cap)].set(
+                v8, mode="drop")[:cap]
+            return bs.seg_or_fill_multi_best(
+                rt.pack_bits_multi(seed, npad), run_bits)
+
+        def body(carry):
+            newv, visited, pcand, lanelvl, lvl, _ = carry
+            newc = lax.ppermute(newv, (ROW_AXIS, COL_AXIS), tperm)
+            eact_c = expand_runs_multi(newc, tile_n, cstarts[:-1],
+                                       cdeg > 0, cb)
+            eact_r = rt.apply_route_multi_best(rp, eact_c)
+            hit = eact_r & vb[:, None]
+            reached_e = bs.seg_or_fill_multi_best(hit, sb)
+            rbits = extract_row_bits_multi(reached_e)
+            allv = lax.all_gather(rbits, COL_AXIS)       # (pc, nwv, W)
+            reached = allv[0]
+            for k in range(1, pc):
+                reached = reached | allv[k]
+            new2v = reached & ~visited
+            new2_e = expand_runs_multi(new2v, tile_m, rstarts[:-1],
+                                       row_nonempty, sb)
+            pcand = pcand | (hit & new2_e)
+            adv = lax.pmax(
+                jnp.any(new2v != 0, axis=0).astype(jnp.int32),
+                (ROW_AXIS, COL_AXIS))                    # (W,) global
+            return (new2v, visited | new2v, pcand,
+                    lanelvl + adv, lvl + 1, jnp.any(adv > 0))
+
+        _pvary = (partial(lax.pcast, to="varying")
+                  if hasattr(lax, "pcast") else lax.pvary)
+        newv0v = _pvary(newv0, (COL_AXIS,))
+        pcand0v = _pvary(pcand0, (ROW_AXIS, COL_AXIS))
+        lanelvl0v = _pvary(lanelvl0, (ROW_AXIS, COL_AXIS))
+        newv_f, _, pcand, lanelvl, _, _ = lax.while_loop(
+            lambda c: c[5] & (c[4] < ml) & (c[4] < lvl_cap), body,
+            (newv0v, newv0v, pcand0v, lanelvl0v, jnp.int32(0),
+             jnp.bool_(True)))
+        # per-lane done: the lane's frontier was empty ANYWHERE on the
+        # mesh when the wave stopped (ml truncation leaves it live)
+        anyfront = lax.pmax(
+            jnp.any(newv_f != 0, axis=0).astype(jnp.int32),
+            (ROW_AXIS, COL_AXIS))
+
+        # parent extraction: the single-root segmented max over global
+        # column ids, vmapped over lanes, then pmax along the mesh row
+        def extract_lane(pcw):
+            pc8 = rt.unpack_bits(pcw, cap)
+            eb = tl.to_chunked(pc8, fill=0).reshape(-1)
+            e_act = (eb > 0) & valid_t
+            contrib = jnp.where(
+                e_act, cols_t + j.astype(jnp.int32) * tile_n, _IDENT)
+            return tl.seg_reduce_pre(
+                S.MAX, contrib.reshape(chunk_len, 128),
+                starts_t.reshape(chunk_len, 128), ends_m, nonempty)
+
+        y = jax.vmap(extract_lane, in_axes=1, out_axes=1)(pcand)
+        y = lax.pmax(y, COL_AXIS)                        # (tile_m, W)
+        parents = jnp.where(y != _IDENT, y, NO_PARENT)
+        # roots self-parent, per lane where the root lives in this block
+        pp = jnp.concatenate(
+            [parents, jnp.zeros((1, w_lanes), jnp.int32)])
+        pp = pp.at[jnp.where(inblk_l, rloc_l, tile_m), w_ix].set(roots)
+        return pp[None, :tile_m], lanelvl[None], anyfront[None]
+
+    spec3 = P(ROW_AXIS, COL_AXIS, None)
+    rspec = P(ROW_AXIS, COL_AXIS,
+              *([None] * (plan.route_masks.ndim - 2)))
+    parents, lanelvl, anyfront = jax.shard_map(
+        f, mesh=grid.mesh,
+        in_specs=(spec3,) * 7 + (rspec,) + (spec3,) * 4,
+        out_specs=(P(ROW_AXIS, None, None), P(ROW_AXIS, None),
+                   P(ROW_AXIS, None)),
+    )(plan.cols_t, plan.starts_t, plan.valid_t, plan.ends_m, plan.nonempty,
+      plan.cstarts, plan.cdeg, plan.route_masks, plan.starts_bits,
+      plan.valid_bits, plan.cstart_bits, plan.rstarts)
+    return (dmm.DistMultiVec(parents, grid, ROW_AXIS, a.nrows),
+            lanelvl[0], anyfront[0] == 0)
+
+
+_bfs_batch_bits_mesh_core = obs.instrument(_bfs_batch_bits_mesh_core,
+                                           "bfs.batch_bits_mesh")
 
 
 @jax.jit

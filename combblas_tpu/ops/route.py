@@ -752,3 +752,28 @@ def unpack_bits(words: jax.Array, n: int) -> jax.Array:
     bytes_ = lax.bitcast_convert_type(words, jnp.uint8).reshape(-1, 1)
     bits = (bytes_ >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
     return bits.reshape(-1)[:n].astype(jnp.int8)
+
+
+def pack_bits_multi(bits: jax.Array, npad: int) -> jax.Array:
+    """(n, W) bool/int8 -> (npad/32, W) uint32: `pack_bits` per lane
+    (column), same little-endian bit order in every lane — lane w of
+    the output is exactly pack_bits(bits[:, w], npad)."""
+    n, w = bits.shape
+    b8 = bits.astype(jnp.uint8)
+    if npad != n:
+        b8 = jnp.pad(b8, ((0, npad - n), (0, 0)))
+    nyb = b8.reshape(-1, 8, w)
+    bytes_ = (nyb << jnp.arange(8, dtype=jnp.uint8)[None, :, None]).sum(
+        axis=1, dtype=jnp.uint8)
+    return lax.bitcast_convert_type(
+        bytes_.reshape(-1, 4, w).transpose(0, 2, 1), jnp.uint32)
+
+
+def unpack_bits_multi(words: jax.Array, n: int) -> jax.Array:
+    """(npad/32, W) uint32 -> (n, W) int8, inverse of pack_bits_multi
+    (lane w = unpack_bits(words[:, w], n))."""
+    w = words.shape[1]
+    bytes_ = lax.bitcast_convert_type(words, jnp.uint8)   # (nw, W, 4)
+    bits = (bytes_[..., None] >> jnp.arange(8, dtype=jnp.uint8)) \
+        & jnp.uint8(1)                                    # (nw, W, 4, 8)
+    return bits.transpose(0, 2, 3, 1).reshape(-1, w)[:n].astype(jnp.int8)

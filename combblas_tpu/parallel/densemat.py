@@ -215,6 +215,24 @@ def mv_realign(v: DistMultiVec, axis: str, block: Optional[int] = None,
     return DistMultiVec(data, v.grid, axis, v.glen)
 
 
+def _spmm_local(sr: Semiring, a: DistSpMat, rows, cols, vals, nnz, xx):
+    """One tile's SpMM contribution (inside shard_map): gather the
+    operand panel at the columns, multiply, segment-reduce per row,
+    monoid fan-in along the mesh row. Shared by both schedules."""
+    t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
+                a.tile_m, a.tile_n)
+    v = t.valid()
+    cg = jnp.clip(t.cols, 0, a.tile_n - 1)
+    contrib = sr.multiply(t.vals[:, None], xx[cg])    # (cap, width)
+    ident = sr.add.identity(contrib.dtype)
+    contrib = jnp.where(v[:, None], contrib, ident)
+    starts, seg_ends, nonempty = tl.row_structure(t)
+    y = jax.vmap(lambda col: tl.seg_reduce_sorted(
+        sr.add, col, starts, seg_ends, nonempty),
+        in_axes=1, out_axes=1)(contrib)          # (tile_m, width)
+    return sr.add.axis_reduce(y, COL_AXIS)[None]
+
+
 @partial(jax.jit, static_argnames=("sr",))
 def spmm(sr: Semiring, a: DistSpMat, x: DistMultiVec) -> DistMultiVec:
     """Y = A ⊗ X for a c-aligned dense batch X (n, width) -> r-aligned
@@ -228,19 +246,7 @@ def spmm(sr: Semiring, a: DistSpMat, x: DistMultiVec) -> DistMultiVec:
     mesh = a.grid.mesh
 
     def f(rows, cols, vals, nnz, xb):
-        t = tl.Tile(rows[0, 0], cols[0, 0], vals[0, 0], nnz[0, 0],
-                    a.tile_m, a.tile_n)
-        xx = xb[0]                               # (tile_n, width)
-        v = t.valid()
-        cg = jnp.clip(t.cols, 0, a.tile_n - 1)
-        contrib = sr.multiply(t.vals[:, None], xx[cg])    # (cap, width)
-        ident = sr.add.identity(contrib.dtype)
-        contrib = jnp.where(v[:, None], contrib, ident)
-        starts, seg_ends, nonempty = tl.row_structure(t)
-        y = jax.vmap(lambda col: tl.seg_reduce_sorted(
-            sr.add, col, starts, seg_ends, nonempty),
-            in_axes=1, out_axes=1)(contrib)      # (tile_m, width)
-        return sr.add.axis_reduce(y, COL_AXIS)[None]
+        return _spmm_local(sr, a, rows, cols, vals, nnz, xb[0])
 
     data = jax.shard_map(
         f, mesh=mesh,
@@ -249,3 +255,54 @@ def spmm(sr: Semiring, a: DistSpMat, x: DistMultiVec) -> DistMultiVec:
         out_specs=P(ROW_AXIS, None, None),
     )(a.rows, a.cols, a.vals, a.nnz, x.data)
     return DistMultiVec(data, a.grid, ROW_AXIS, a.nrows)
+
+
+@partial(jax.jit, static_argnames=("sr",))
+def _spmm_tall_core(sr: Semiring, a: DistSpMat, x: DistMultiVec
+                    ) -> DistMultiVec:
+    """Square-mesh tall-and-skinny schedule (see spmm_tall): the
+    skinny panel hops (i,j)<->(j,i) with ONE collective_permute."""
+    mesh = a.grid.mesh
+    pr, pc = a.grid.pr, a.grid.pc
+    tperm = [(j * pc + i, i * pc + j) for i in range(pr) for j in range(pc)]
+    _pvary = (partial(lax.pcast, to="varying")
+              if hasattr(lax, "pcast") else lax.pvary)
+
+    def f(rows, cols, vals, nnz, xb):
+        # device (j, i) holds panel j; the transpose pair delivers it
+        # to (i, j), which needs exactly X's column block j
+        xx = lax.ppermute(_pvary(xb[0], (COL_AXIS,)),
+                          (ROW_AXIS, COL_AXIS), tperm)
+        return _spmm_local(sr, a, rows, cols, vals, nnz, xx)
+
+    data = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(ROW_AXIS, COL_AXIS, None),) * 3
+                 + (P(ROW_AXIS, COL_AXIS), P(ROW_AXIS, None, None)),
+        out_specs=P(ROW_AXIS, None, None),
+    )(a.rows, a.cols, a.vals, a.nnz, x.data)
+    return DistMultiVec(data, a.grid, ROW_AXIS, a.nrows)
+
+
+def spmm_tall(sr: Semiring, a: DistSpMat, x: DistMultiVec) -> DistMultiVec:
+    """Y = A ⊗ X, stacked-RHS-aware: the tall-and-skinny SpMM schedule
+    for serve's `mv_stack` batches (the 1.5D shape of arXiv:2408.11988
+    — the sparse operand is the big one, so it stays STATIONARY and
+    only the skinny dense panel moves).
+
+    A row-aligned X (the alignment every upstream result already has)
+    is exchanged to its transpose mesh position with ONE
+    `collective_permute` of the packed (block, width) panel — the
+    whole batch rides one exchange, where W per-request `spmv` calls
+    would pay the r->c realignment W times — and A's tiles never move
+    at all (the amortized "A-panel broadcast": one resident panel
+    serves all W columns). Requires a square mesh (the (i,j)<->(j,i)
+    pairing); column-aligned input goes straight to `spmm`, and
+    non-square meshes fall back to `mv_realign` + `spmm` (bit-exact
+    either way — the schedules reorder no reduction)."""
+    if x.axis == COL_AXIS:
+        return spmm(sr, a, x)
+    if (a.grid.pr != a.grid.pc or x.block != a.tile_n
+            or x.nblocks != a.grid.pr):
+        return spmm(sr, a, mv_realign(x, COL_AXIS, block=a.tile_n))
+    return _spmm_tall_core(sr, a, x)

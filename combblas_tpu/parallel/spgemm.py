@@ -40,6 +40,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import time
 from functools import partial
 from typing import Callable, Optional
 
@@ -88,6 +89,9 @@ _M_DENSITY = obm.histogram("spgemm.window_density",
 _M_HUBSPLIT = obm.counter("spgemm.hub_splits",
                           "column windows bisected because their flop "
                           "share exceeded the hub factor x median")
+_M_BCAST = obm.counter("spgemm.bcast",
+                       "SUMMA tile broadcasts per exchange variant "
+                       "(kind=dense|sparse)")
 
 
 def _check_product(a: DistSpMat, b: DistSpMat):
@@ -187,9 +191,18 @@ def plan_flops_total(a: DistSpMat, b: DistSpMat) -> int:
 # Streaming SUMMA (≅ Mult_AnXBn_Synch, ParFriends.h:1005)
 # ---------------------------------------------------------------------------
 
-def _bcast_tile(r, c, v, n, is_src, axis, nrows, ncols):
+def _bcast_tile(r, c, v, n, is_src, axis, nrows, ncols, k=None):
     """Broadcast one device's tile along a mesh axis: masked psum with
-    a single contributor (≅ BCastMatrix, SpParHelper.cpp:583)."""
+    a single contributor (≅ BCastMatrix, SpParHelper.cpp:583).
+
+    ``k`` selects the SPARSE exchange: only the k-slot nnz-prefix of
+    the COO arrays ships (k is a static `plan_bcast` rung covering
+    every source tile's nnz in this broadcast group, so the prefix —
+    live entries + sentinel padding — reconstructs the tile losslessly
+    at capacity k). ``k=None`` is the dense reference: the full
+    cap-padded arrays, volume O(cap) regardless of nnz."""
+    if k is not None:
+        r, c, v = r[:k], c[:k], v[:k]
     r2 = lax.psum(jnp.where(is_src, r, 0), axis)
     c2 = lax.psum(jnp.where(is_src, c, 0), axis)
     if v.dtype == jnp.bool_:
@@ -201,9 +214,135 @@ def _bcast_tile(r, c, v, n, is_src, axis, nrows, ncols):
     return tl.Tile(r2, c2, v2, n2, nrows, ncols)
 
 
-@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap"))
+BCAST_VARIANTS = ("dense", "sparse")
+
+
+def bcast_variant_mode() -> str:
+    """COMBBLAS_TPU_BCAST_VARIANT = dense | sparse | auto (default).
+    Global selector for the per-round SUMMA exchange: ``dense`` forces
+    the full cap-padded masked-psum broadcast everywhere (the opt-out
+    reference), ``sparse`` forces the nnz-prefix exchange on every
+    round it helps (rounds whose prefix rung reaches cap stay dense —
+    there is nothing to save), ``auto`` ships the prefix only when it
+    is at most `bcast_sparse_threshold()` x cap. Read per call so
+    tests can flip it without re-importing."""
+    v = os.environ.get("COMBBLAS_TPU_BCAST_VARIANT", "auto").lower()
+    if v not in ("dense", "sparse", "auto"):
+        raise ValueError(
+            f"COMBBLAS_TPU_BCAST_VARIANT={v!r}: expected one of "
+            "dense|sparse|auto")
+    return v
+
+
+def bcast_sparse_threshold() -> float:
+    """``auto`` ships the sparse prefix when its rung is at most this
+    fraction of cap (COMBBLAS_TPU_BCAST_THRESHOLD, default 0.5 — the
+    prefix rungs are powers of two, so 0.5 means "at least halve the
+    per-round volume or don't bother minting the extra shape")."""
+    return _env_num("COMBBLAS_TPU_BCAST_THRESHOLD", 0.5)
+
+
+def plan_bcast(a: DistSpMat, b: DistSpMat, *, mode: Optional[str] = None,
+               threshold: Optional[float] = None) -> tuple:
+    """Static per-interval exchange plan: one ``(a_variant, a_k,
+    b_variant, b_k)`` row per SUMMA interval, decided host-side from
+    the plan-time per-tile nnz (the same numbers `plan_spgemm` reads —
+    no device sync). The A-side rung covers max over mesh rows of
+    nnz(A[i, ja]); the B-side rung covers max over mesh columns of
+    nnz(B[ib, j]) — each broadcast group's sources all fit the shipped
+    prefix. Rungs are quarter-octave buckets (`_bucket_fine`, floor
+    128 — the CapLadder rung rule): at most 25% padded slots shipped
+    while repeated products of similar sparsity still land on ≤4
+    compile shapes per octave. Hashable (nested tuples): passed to
+    `summa` as a static argument."""
+    _check_product(a, b)
+    mode = bcast_variant_mode() if mode is None else mode
+    thr = bcast_sparse_threshold() if threshold is None else threshold
+    annz = np.asarray(a.nnz)                     # (pr, pc)
+    bnnz = np.asarray(b.nnz)
+    acap, bcap = a.rows.shape[-1], b.rows.shape[-1]
+
+    def side(req: int, cap: int):
+        k = min(cap, _bucket_fine(max(int(req), 1), 128))
+        if mode == "dense" or k >= cap:
+            return ("dense", cap)
+        if mode == "sparse" or k <= thr * cap:
+            return ("sparse", k)
+        return ("dense", cap)
+
+    return tuple(
+        side(annz[:, ja].max(), acap) + side(bnnz[ib, :].max(), bcap)
+        for (lo, hi, ja, la, ib, lb) in _summa_intervals(a, b))
+
+
+def _bcast_payload_bytes(k: int, dtype) -> int:
+    """Per-device payload of one tile broadcast: k COO slots (two i32
+    index planes + values; bool values ship as i32 inside the psum)
+    plus the nnz scalar."""
+    vb = 4 if dtype == jnp.bool_ else np.dtype(dtype).itemsize
+    return (8 + vb) * int(k) + 4
+
+
+def bcast_round_bytes(a: DistSpMat, b: DistSpMat,
+                      plan: Optional[tuple] = None) -> dict:
+    """Static ICI-volume accounting for one full SUMMA sweep: bytes
+    actually shipped per device under ``plan`` (default: the current
+    env-selected plan) vs the all-dense reference, counting only the
+    broadcasts the stage loop executes (consecutive intervals sharing
+    an operand tile re-broadcast nothing)."""
+    if plan is None:
+        plan = plan_bcast(a, b)
+    intervals = _summa_intervals(a, b)
+    acap, bcap = a.rows.shape[-1], b.rows.shape[-1]
+    out = {"hybrid_bytes": 0, "dense_bytes": 0,
+           "bcasts": {"dense": 0, "sparse": 0}}
+    prev_ja = prev_ib = None
+    for (lo, hi, ja, la, ib, lb), (avar, ak, bvar, bk) in zip(
+            intervals, plan):
+        if ja != prev_ja:
+            out["hybrid_bytes"] += _bcast_payload_bytes(ak, a.vals.dtype)
+            out["dense_bytes"] += _bcast_payload_bytes(acap, a.vals.dtype)
+            out["bcasts"][avar] += 1
+            prev_ja = ja
+        if ib != prev_ib:
+            out["hybrid_bytes"] += _bcast_payload_bytes(bk, b.vals.dtype)
+            out["dense_bytes"] += _bcast_payload_bytes(bcap, b.vals.dtype)
+            out["bcasts"][bvar] += 1
+            prev_ib = ib
+    return out
+
+
+def _record_bcasts(a: DistSpMat, b: DistSpMat, plan: tuple) -> None:
+    """Host-side ledger emission for the exchange mix: one
+    `spgemm.bcast/{dense,sparse}` dispatch record per broadcast the
+    stage loop will execute, arg_bytes = the per-device payload — so
+    every `dispatch_summary` shows the hybrid ratio by name. Emitted
+    at plan time (the collectives run inside one fused SUMMA dispatch,
+    so there is no per-broadcast host boundary to instrument)."""
+    intervals = _summa_intervals(a, b)
+    t0 = time.perf_counter()
+    prev_ja = prev_ib = None
+    for (lo, hi, ja, la, ib, lb), (avar, ak, bvar, bk) in zip(
+            intervals, plan):
+        if ja != prev_ja:
+            obs.ledger.record(f"spgemm.bcast/{avar}", "dispatch", t0, 0.0,
+                              arg_bytes=_bcast_payload_bytes(
+                                  ak, a.vals.dtype))
+            _M_BCAST.inc(kind=avar)
+            prev_ja = ja
+        if ib != prev_ib:
+            obs.ledger.record(f"spgemm.bcast/{bvar}", "dispatch", t0, 0.0,
+                              arg_bytes=_bcast_payload_bytes(
+                                  bk, b.vals.dtype))
+            _M_BCAST.inc(kind=bvar)
+            prev_ib = ib
+
+
+@partial(jax.jit, static_argnames=("sr", "flops_cap", "out_cap",
+                                   "bcast_plan"))
 def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
-          flops_cap: int, out_cap: int) -> DistSpMat:
+          flops_cap: int, out_cap: int,
+          bcast_plan: Optional[tuple] = None) -> DistSpMat:
     """C = A ⊗ B by streaming sparse SUMMA on any grid.
 
     ``flops_cap`` bounds each stage's local multiply expansion;
@@ -211,9 +350,22 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     `plan_spgemm`. Peak per-device memory is O(cap + flops_cap +
     out_cap): one broadcast tile pair in flight, stage outputs folded
     into the accumulator immediately.
+
+    ``bcast_plan`` (from `plan_bcast`; None = all-dense reference)
+    selects the per-interval exchange: dense cap-padded masked psum,
+    or the sparse nnz-prefix exchange that ships only a static
+    CapLadder-style rung of the COO arrays.
     """
     _check_product(a, b)
     intervals = _summa_intervals(a, b)
+    if bcast_plan is not None and len(bcast_plan) != len(intervals):
+        raise ValueError(
+            f"bcast_plan has {len(bcast_plan)} rows for "
+            f"{len(intervals)} SUMMA intervals — plan the same product")
+    bplan = (bcast_plan if bcast_plan is not None
+             else tuple(("dense", a.rows.shape[-1],
+                         "dense", b.rows.shape[-1])
+                        for _ in intervals))
     mesh = a.grid.mesh
     tile_m, tile_nb = a.tile_m, b.tile_n
     stage_cap = min(flops_cap, out_cap)
@@ -229,16 +381,19 @@ def summa(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         acc = None
         at = bt = None
         prev_ja = prev_ib = None
-        for (lo, hi, ja, la, ib, lb) in intervals:
+        for (lo, hi, ja, la, ib, lb), (avar, ak, bvar, bk) in zip(
+                intervals, bplan):
             # consecutive intervals often share one operand tile (a cut
             # from only the other tiling); re-broadcast only on change
             if ja != prev_ja:
                 at = _bcast_tile(ar, ac, av, an, my_c == ja, COL_AXIS,
-                                 a.tile_m, a.tile_n)
+                                 a.tile_m, a.tile_n,
+                                 k=ak if avar == "sparse" else None)
                 prev_ja = ja
             if ib != prev_ib:
                 bt = _bcast_tile(br, bc, bv, bn, my_r == ib, ROW_AXIS,
-                                 b.tile_m, b.tile_n)
+                                 b.tile_m, b.tile_n,
+                                 k=bk if bvar == "sparse" else None)
                 prev_ib = ib
             part = tl.spgemm_ranged(
                 sr, at, bt, a_lo=la, b_lo=lb, length=hi - lo,
@@ -297,9 +452,12 @@ def _planned_summa(sr: Semiring, a: DistSpMat, b: DistSpMat,
             raise ValueError(
                 f"{what} needs a {fc}-slot expansion (> 2^30); "
                 "use spgemm_phased (or more phases)")
+        bplan = plan_bcast(a, b)
+        _record_bcasts(a, b, bplan)
     with obs.span("summa", category="device_execute",
                   flops_cap=fc, out_cap=oc):
-        out = summa(sr, a, b, flops_cap=fc, out_cap=oc)
+        out = summa(sr, a, b, flops_cap=fc, out_cap=oc,
+                    bcast_plan=bplan)
         obs.sync(out.rows)
     _M_FLOPS.inc(fc)
     return out

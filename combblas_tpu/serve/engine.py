@@ -6,12 +6,15 @@ off the queue (batcher.py), pads it to the jit bucket, runs ONE
 compiled executable for the whole batch (plans.py), and fans results
 back out to the per-request handles. Query kinds:
 
-* **bfs** — eligible matrices (single-tile, routed, pattern-
-  symmetric; cfg.bfs_bits / COMBBLAS_TPU_SERVE_BITS=0) batch through
-  `models.bfs.bfs_batch_bits`: packed-bit bitplane frontiers, 32
-  roots per uint32 word, buckets lane-aligned to 32. Everything else
-  rides the columns of `models.bfs.bfs_batch` (one while_loop
-  traversal for the whole batch, bit-exact vs per-root `bfs`).
+* **bfs** — eligible matrices (routed + 1x1 pattern-symmetric, OR a
+  square routed mesh; cfg.bfs_bits / COMBBLAS_TPU_SERVE_BITS=0) batch
+  through `models.bfs.bfs_batch_bits`: packed-bit bitplane frontiers,
+  32 roots per uint32 word, buckets lane-aligned to 32 — on meshes
+  the lane-packed words ride the explicit transpose exchange
+  (`_bfs_batch_bits_mesh_core`). Everything else rides the columns of
+  `models.bfs.bfs_batch` (one while_loop traversal for the whole
+  batch, bit-exact vs per-root `bfs`); each degradation is surfaced
+  in /varz (`bfs_bits.fallback_reason`).
   Deadlines degrade gracefully on both paths: the level budget is
   min-remaining-time / EWMA-per-level-estimate, and roots whose
   traversal was truncated return `BfsResult(complete=False)` with the
@@ -20,9 +23,11 @@ back out to the per-request handles. Query kinds:
   single amortized dispatch); each batch of lookups is one device
   gather.
 * **spmv:<semiring>** — operand vectors stack into the columns of one
-  `densemat.spmm`. SpMSpV queries densify (mask -> add-identity,
-  which annihilates every shipped semiring's multiply) and coalesce
-  into the SAME batches.
+  `densemat.spmm` (on square meshes the tall-and-skinny
+  `densemat.spmm_tall` schedule: the stacked panel ships with one
+  collective_permute, A stays put). SpMSpV queries densify (mask ->
+  add-identity, which annihilates every shipped semiring's multiply)
+  and coalesce into the SAME batches.
 
 Instrumented through `combblas_tpu.obs` (queue-depth gauge,
 batch-occupancy + latency histograms with p50/p90/p99, shed/dispatch
@@ -128,6 +133,7 @@ class GraphService:
         # callers hand in a prebuilt BfsPlan (routed or not).
         self._base_plan = plan
         self._bits_plan = None
+        self._bits_reason = None      # why the bits path is off, if it is
         self._plans_resolved = False
         self._plan_lock = threading.Lock()
         if self.cfg.latency_sketch:
@@ -198,6 +204,20 @@ class GraphService:
             "plans": len(self.plans),
             "cost_est_s": dict(self._cost_est),
             "bfs_level_est_s": self._bfs_level_est,
+            # packed-bit path visibility: which BFS path this service
+            # resolved to (and why not bits, if not), plus the
+            # process-wide degradation counters (populated when obs
+            # tracing is on) — fleet operators see the 32x economics
+            # being lost without grepping logs
+            "bfs_bits": {
+                "path": ("bits" if self._bits_plan is not None
+                         else ("unresolved" if not self._plans_resolved
+                               else "dense")),
+                "fallback_reason": self._bits_reason,
+                "fallbacks": {
+                    r: _bfs._M_BITS_FALLBACK.value(kind=r)
+                    for r in _bfs.BITS_FALLBACK_REASONS},
+            },
         }
 
     def _fail_pending(self) -> None:
@@ -421,8 +441,10 @@ class GraphService:
         """Resolve (base_plan, bits_plan) once, lazily. The bits plan
         exists iff the packed-bit batch path is wanted
         (cfg.bfs_bits, COMBBLAS_TPU_SERVE_BITS env) AND eligible
-        (single-tile mesh, routed, verified pattern-symmetric —
-        `models.bfs.bits_batch_ok`)."""
+        (routed + pattern-symmetric on a 1x1 grid, or a square routed
+        mesh with square vertex blocks — `models.bfs.bits_batch_ok`).
+        When ineligible, the reason label lands in /varz
+        (`bfs_bits.fallback_reason`)."""
         # Single-flight plan resolution: the tracing under this lock is
         # intentional — it runs ONCE per service lifetime, before any
         # worker dispatches, and serialization is the point (two threads
@@ -437,20 +459,34 @@ class GraphService:
                 if mode not in ("auto", "on", "off"):
                     raise ValueError(f"bfs_bits={mode!r}: expected "
                                      "'auto', 'on', or 'off'")
-                if mode != "off" and self._mesh == (1, 1):
+                if mode != "off":
                     cand = self._base_plan
-                    if not _bfs.bits_batch_ok(self.a, cand):
+                    # cheap structural gate before paying for routing:
+                    # a non-square mesh (or non-square blocks) can
+                    # never take the bits path, so don't plan for it
+                    square = (self._mesh == (1, 1)
+                              or (self._mesh[0] == self._mesh[1]
+                                  and self.a.tile_m == self.a.tile_n))
+                    if square and not _bfs.bits_batch_ok(self.a, cand):
                         cand = _bfs.plan_bfs(self.a, route=True)
                     if _bfs.bits_batch_ok(self.a, cand):
                         self._bits_plan = cand
                         if self._base_plan is None:
                             self._base_plan = cand
+                    else:
+                        self._bits_reason = (
+                            "mesh" if not square
+                            else _bfs.bits_fallback_reason(self.a, cand))
+                else:
+                    self._bits_reason = "disabled"
                 if mode == "on" and self._bits_plan is None:
                     raise ValueError(
                         "bfs_bits='on' but the matrix is not eligible "
-                        "for the packed-bit batch path (needs a 1x1 "
-                        "grid and a pattern-symmetric matrix; see "
-                        "models.bfs.bits_batch_ok)")
+                        "for the packed-bit batch path (reason: "
+                        f"{self._bits_reason}; needs a routed plan on "
+                        "a 1x1 grid with verified pattern symmetry, "
+                        "or a square routed mesh with square vertex "
+                        "blocks; see models.bfs.bits_batch_ok)")
                 if self._base_plan is None:
                     self._base_plan = _bfs.plan_bfs(self.a)
                 self._plans_resolved = True
@@ -555,9 +591,21 @@ class GraphService:
         def build():
             grid, tn, glen = self.a.grid, self.a.tile_n, self.a.ncols
             nrows = self.a.nrows
+            # square meshes take the tall-and-skinny schedule: the
+            # stacked panel enters ROW-aligned (the serve-native
+            # alignment) and hops once via collective_permute while
+            # A's tiles stay put (densemat.spmm_tall)
+            tall = grid.pr == grid.pc and self.a.tile_m == tn
 
             @partial(jax.jit)
             def run(a, arr):                  # arr: (glen, W)
+                if tall:
+                    data = jnp.pad(
+                        arr, ((0, grid.pr * tn - glen), (0, 0)))
+                    x = dmm.DistMultiVec(
+                        data.reshape(grid.pr, tn, arr.shape[1]), grid,
+                        ROW_AXIS, glen)
+                    return dmm.spmm_tall(sr, a, x).data
                 data = jnp.pad(
                     arr, ((0, grid.pc * tn - glen), (0, 0)))
                 x = dmm.DistMultiVec(

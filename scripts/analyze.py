@@ -113,6 +113,11 @@ def self_test() -> int:
     expect("dense zero-sort pin", {f.rule for f in fs},
            core.SORT_COUNT, core.SORT_ARITY)
 
+    print("fixture: bad_hybrid_bcast_budget.json")
+    fs = budget.run_budgets(files=[fx / "bad_hybrid_bcast_budget.json"])
+    expect("hybrid exchange collective ceiling", {f.rule for f in fs},
+           core.SORT_COUNT, core.OP_CEILING)
+
     print("fixture: bad_megastep_budget.json")
     fs = budget.run_budgets(files=[fx / "bad_megastep_budget.json"])
     expect("mega-step budget", {f.rule for f in fs},

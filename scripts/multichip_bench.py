@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Multi-chip dry run + scale-out exchange bench -> MULTICHIP_r06.json.
+
+Promotes the driver's `dryrun_multichip` smoke into a real bench with
+three sections (``--kinds``, comma-separated, default all):
+
+  dryrun   the full correctness sweep on an emulated n-device mesh:
+           distributed BFS, FastSV (sharded vs replicated), streaming
+           + phased SUMMA parity, and the routed square-submesh
+           packed-bit BFS visited-set check;
+  spgemm   the communication-avoiding claim: per-round exchanged bytes
+           of the hybrid sparse/dense SUMMA broadcast vs the all-dense
+           exchange on a scale-``--scale`` R-MAT SpGEMM, with the
+           result pinned bit-exact (identical c_nnz AND identical
+           rows/cols/vals arrays) between COMBBLAS_TPU_BCAST_VARIANT=
+           dense and =auto runs;
+  bits     the mesh bitplane-BFS claim: serve's bits path resolves
+           (does not fall back) on a 2x2 routed mesh, and a warm
+           32-root `bfs_batch_bits` is per-root no slower than the
+           dense-column `bfs_batch` on the same mesh.
+
+Everything runs under obs spans; the headline JSON embeds
+`obs.dispatch_summary()` plus the `spgemm.bcast/{dense,sparse}`
+ledger tallies. bench.py-style output: one JSON line per section,
+the LAST line is the headline dict (also written to ``--out``).
+
+Usage: multichip_bench.py [--devices 8] [--scale 12] [--bits-scale 12]
+                          [--kinds dryrun,spgemm,bits] [--seed 7]
+                          [--out MULTICHIP_r06.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import __graft_entry__ as GE  # noqa: E402  (repo-root entry: backend forcing + toy graph)
+
+KINDS = ("dryrun", "spgemm", "bits")
+
+
+def _rmat(grid, scale, seed, *, dtype=None):
+    import jax
+    import jax.numpy as jnp
+    from combblas_tpu.ops import generate
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import distmat as dm
+    r, c = generate.rmat_edges(jax.random.key(seed), scale, 8)
+    r, c = generate.symmetrize(r, c)
+    n = 1 << scale
+    a = dm.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    return a.astype(dtype) if dtype is not None else a
+
+
+def run_dryrun(n_devices):
+    """The promoted `dryrun_multichip` body: every check from the
+    driver smoke, on an already-forced n-device virtual mesh.
+    Asserts on failure; returns the checks-passed summary dict."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu import obs
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.models import cc as CC
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import spgemm as spg
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    devs = jax.devices()[:n_devices]
+    assert len(devs) >= n_devices, (
+        f"only {len(devs)} devices after forcing CPU backend")
+    checks = []
+    with obs.span("dryrun"):
+        grid = ProcGrid.make(devices=devs)
+        a = GE._toy_graph(grid, n=64)
+        with obs.span("bfs"):
+            parents = B.bfs(a, jnp.int32(0))
+            parents.data.block_until_ready()
+        assert int(np.asarray(parents.data)[0, 0]) == 0
+        checks.append("bfs")
+
+        # FastSV connected components (Select2ndMin SpMV + hooking loop)
+        with obs.span("fastsv"):
+            labels = CC.fastsv(a)
+            labels.data.block_until_ready()
+        lg = labels.to_global()
+        assert (lg >= 0).all() and lg[0] == 0  # vertex 0's root is itself
+        checks.append("fastsv")
+
+        # streaming SUMMA on the full grid (square or not: stage
+        # structure comes from the merged tile-boundary intervals)
+        af = a.astype(jnp.float32)
+        with obs.span("spgemm"):
+            c = spg.spgemm(S.PLUS_TIMES_F32, af, af)
+            c.vals.block_until_ready()
+        assert c.getnnz() > 0
+        checks.append("spgemm")
+
+        # phased memory-bounded SpGEMM exercises ColSplit + per-phase SUMMA
+        with obs.span("spgemm_phased"):
+            cp = spg.spgemm_phased(S.PLUS_TIMES_F32, af, af, phases=2)
+            cp.vals.block_until_ready()
+        assert cp.getnnz() == c.getnnz()
+        checks.append("spgemm_phased")
+
+        # distributed edge-space bit BFS (Beneš-routed packed-bit
+        # kernel) on a square sub-mesh: ppermute transpose exchange +
+        # packed-word all_gather + in-loop route/bit-scans
+        side = int(np.sqrt(n_devices))
+        if side >= 2:
+            sq = ProcGrid.make(side, side, devs[:side * side])
+            a2 = GE._toy_graph(sq, n=64)
+            plan2 = B.plan_bfs(a2, route=True)
+            assert B._bits_mesh_ok(a2, plan2), "routed square-mesh plan"
+            with obs.span("bfs_bits_mesh"):
+                pb = B.bfs_bits_mesh(a2, jnp.int32(0), plan2)
+                pb.data.block_until_ready()
+            ps = B.bfs(a2, jnp.int32(0))
+            assert (np.asarray(pb.to_global()) >= 0).tolist() == \
+                (np.asarray(ps.to_global()) >= 0).tolist(), \
+                "bit-BFS visited set != stepper visited set"
+            checks.append("bfs_bits_mesh")
+            # 32-root batched bitplane BFS on the same routed mesh:
+            # visited sets must match the dense-column batch
+            roots = jnp.arange(8, dtype=jnp.int32)
+            mvb, lvb, _ = B.bfs_batch_bits_mesh(a2, roots, plan=plan2)
+            mvd, _, _ = B.bfs_batch(a2, roots, plan=plan2)
+            assert (np.asarray(mvb.to_global()) >= 0).tolist() == \
+                (np.asarray(mvd.to_global()) >= 0).tolist(), \
+                "mesh batch-bits visited set != dense batch"
+            checks.append("bfs_batch_bits_mesh")
+            # sharded-parent FastSV (O(n/p) pieces + all_to_all routed
+            # hooking) — fastsv dispatches to it on square meshes; must
+            # agree bit-for-bit with the replicated implementation
+            lsh = CC.fastsv(a2).to_global()
+            lre = CC._fastsv_replicated(a2).to_global()
+            assert lsh.tolist() == lre.tolist(), \
+                "sharded FastSV != replicated FastSV"
+            checks.append("fastsv_sharded")
+    return {"mode": "dryrun", "n_devices": n_devices,
+            "checks": checks, "ok": True}
+
+
+def run_spgemm(args):
+    """Hybrid vs dense SUMMA exchange on a scale-`args.scale` R-MAT:
+    per-round exchanged bytes, bit-exact output parity, wall time."""
+    import jax
+    import numpy as np
+    from combblas_tpu import obs
+    from combblas_tpu.ops import semiring as S
+    from combblas_tpu.parallel import spgemm as spg
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    devs = jax.devices()[:args.devices]
+    grid = ProcGrid.make(devices=devs)
+    af = _rmat(grid, args.scale, args.seed, dtype=jax.numpy.float32)
+    nnz = int(np.sum(np.asarray(af.nnz)))
+    print(f"# spgemm: scale={args.scale} n={af.nrows} nnz={nnz} "
+          f"grid={grid.pr}x{grid.pc} cap={af.cap}",
+          file=sys.stderr, flush=True)
+
+    plan_auto = spg.plan_bcast(af, af)          # env-default: auto
+    plan_dense = spg.plan_bcast(af, af, mode="dense")
+    rb = spg.bcast_round_bytes(af, af, plan=plan_auto)
+    reduction = rb["dense_bytes"] / max(rb["hybrid_bytes"], 1)
+
+    def run_variant(variant, reps=3):
+        os.environ["COMBBLAS_TPU_BCAST_VARIANT"] = variant
+        try:
+            with obs.span(f"spgemm_{variant}"):
+                c = spg.spgemm(S.PLUS_TIMES_F32, af, af)   # compiles
+                c.vals.block_until_ready()
+                best = float("inf")
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    cw = spg.spgemm(S.PLUS_TIMES_F32, af, af)
+                    cw.vals.block_until_ready()
+                    best = min(best, time.perf_counter() - t0)
+            return c, best
+        finally:
+            os.environ.pop("COMBBLAS_TPU_BCAST_VARIANT", None)
+
+    c_dense, wall_dense = run_variant("dense")
+    c_auto, wall_auto = run_variant("auto")
+    # identical c_nnz AND bit-exact arrays: the sparse exchange is a
+    # lossless nnz-prefix, so the local multiplies see the same tiles
+    exact = all(np.array_equal(np.asarray(getattr(c_dense, f)),
+                               np.asarray(getattr(c_auto, f)))
+                for f in ("rows", "cols", "vals", "nnz"))
+    assert c_dense.getnnz() == c_auto.getnnz(), "c_nnz diverged"
+    assert exact, "hybrid exchange result != forced-dense result"
+
+    bcast = obs.counter("spgemm.bcast")
+    rec = {"mode": "spgemm_exchange", "scale": args.scale, "nnz": nnz,
+           "grid": f"{grid.pr}x{grid.pc}", "tile_cap": int(af.cap),
+           "dense_bytes": rb["dense_bytes"],
+           "hybrid_bytes": rb["hybrid_bytes"],
+           "bytes_reduction_x": round(reduction, 2),
+           "passes_2x": bool(reduction >= 2.0),
+           "bcasts": rb["bcasts"],
+           "ledger_bcast": {k: int(bcast.value(kind=k))
+                            for k in spg.BCAST_VARIANTS},
+           "c_nnz": int(c_auto.getnnz()), "bit_exact": bool(exact),
+           "wall_dense_s": round(wall_dense, 4),
+           "wall_auto_s": round(wall_auto, 4),
+           "stages_dense": len(plan_dense), "stages_auto": len(plan_auto)}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def run_bits(args):
+    """Serve bits path on a 2x2 routed mesh: the plan must resolve
+    (no fallback), and warm per-root wall of the mesh bitplane batch
+    must be no worse than the dense-column `bfs_batch`."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from combblas_tpu import obs, serve
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    devs = jax.devices()[:4]
+    grid = ProcGrid.make(2, 2, devs)
+    a = _rmat(grid, args.bits_scale, args.seed)
+    nnz = int(np.sum(np.asarray(a.nnz)))
+    print(f"# bits: scale={args.bits_scale} n={a.nrows} nnz={nnz} "
+          f"grid=2x2", file=sys.stderr, flush=True)
+    plan = B.plan_bfs(a, route=True)
+    reason = B.bits_fallback_reason(a, plan)
+    assert reason is None, f"mesh bits ineligible: {reason}"
+
+    rng = np.random.default_rng(args.seed)
+    roots = jnp.asarray(rng.integers(0, a.nrows, 32), jnp.int32)
+
+    def timed(fn, reps=5):
+        mv, lvl, done = fn()                    # compile + warm
+        jax.block_until_ready((mv.data, lvl, done))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            mv, lvl, done = fn()
+            jax.block_until_ready((mv.data, lvl, done))
+            best = min(best, time.perf_counter() - t0)
+        return best, mv
+
+    with obs.span("bits_micro"):
+        dense_s, mvd = timed(lambda: B.bfs_batch(a, roots, plan=plan))
+        bits_s, mvb = timed(
+            lambda: B.bfs_batch_bits_mesh(a, roots, plan=plan))
+    # visited-set parity between the two batch kernels on this mesh
+    assert (np.asarray(mvb.to_global()) >= 0).tolist() == \
+        (np.asarray(mvd.to_global()) >= 0).tolist(), \
+        "mesh bits visited set != dense batch visited set"
+
+    # serve-level: the bits plan must resolve on the routed mesh and
+    # actually serve queries through the batched bits kernel
+    svc = serve.GraphService(a)
+    try:
+        handles = [svc.submit_bfs(int(r)) for r in np.asarray(roots[:8])]
+        for r, h in zip(np.asarray(roots[:8]), handles):
+            out = h.result(timeout=600)
+            assert out.parents[int(r)] == int(r)
+        varz = svc._varz()["bfs_bits"]
+        dispatches = svc.stats["dispatches"]
+    finally:
+        svc.stop()
+    assert varz["path"] == "bits", f"serve fell back: {varz}"
+
+    rec = {"mode": "serve_bits_mesh", "scale": args.bits_scale,
+           "nnz": nnz, "grid": "2x2", "path": varz["path"],
+           "fallback_reason": varz["fallback_reason"],
+           "fallbacks": varz["fallbacks"],
+           "dense_wall_s": round(dense_s, 4),
+           "bits_wall_s": round(bits_s, 4),
+           "dense_per_root_ms": round(dense_s / 32 * 1e3, 3),
+           "bits_per_root_ms": round(bits_s / 32 * 1e3, 3),
+           "per_root_speedup": round(dense_s / bits_s, 2),
+           "passes_no_worse": bool(bits_s <= dense_s),
+           "serve_queries": 8, "serve_dispatches": dispatches}
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh size")
+    ap.add_argument("--scale", type=int, default=12,
+                    help="R-MAT scale for the spgemm exchange bench")
+    ap.add_argument("--bits-scale", type=int, default=12,
+                    help="R-MAT scale for the 2x2 mesh bits bench")
+    ap.add_argument("--kinds", default=",".join(KINDS),
+                    help=f"comma-separated subset of {KINDS}")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    kinds = tuple(k.strip() for k in args.kinds.split(",") if k.strip())
+    bad = set(kinds) - set(KINDS)
+    if bad:
+        ap.error(f"unknown --kinds {sorted(bad)}; choose from {KINDS}")
+    root_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if args.out is None:
+        args.out = os.path.join(root_dir, "MULTICHIP_r06.json")
+
+    GE._force_cpu_backend(args.devices)
+    from combblas_tpu import obs
+    obs.set_enabled(True)
+    obs.reset()
+    obs.REGISTRY.reset()
+    obs.ledger.reset()
+
+    sections = {}
+    if "dryrun" in kinds:
+        sections["dryrun"] = run_dryrun(args.devices)
+        print(json.dumps(sections["dryrun"]), flush=True)
+    if "spgemm" in kinds:
+        sections["spgemm"] = run_spgemm(args)
+    if "bits" in kinds:
+        sections["bits"] = run_bits(args)
+    summary = obs.dispatch_summary()
+    obs.set_enabled(False)
+
+    headline = {
+        "n_devices": args.devices, "rc": 0,
+        "ok": all(s.get("ok", True) for s in sections.values())
+              and sections.get("spgemm", {}).get("passes_2x", True)
+              and sections.get("bits", {}).get("passes_no_worse", True),
+        "kinds": list(kinds),
+        **{k: v for k, v in sections.items()},
+        "dispatch_summary": summary,
+        "note": "dryrun: full correctness sweep on the virtual mesh. "
+                "spgemm: per-round exchanged bytes of the hybrid "
+                "sparse/dense SUMMA broadcast vs all-dense on a "
+                f"scale-{args.scale} R-MAT, output pinned bit-exact "
+                "between COMBBLAS_TPU_BCAST_VARIANT=dense and =auto. "
+                "bits: serve bitplane-BFS path resolving on a 2x2 "
+                "routed mesh, warm 32-root per-root wall vs dense "
+                "bfs_batch (best of 5).",
+    }
+    line = json.dumps(headline)
+    print(line)
+    if args.out and args.out != "0":
+        with open(args.out, "w") as f:
+            f.write(json.dumps(headline, indent=2) + "\n")
+
+
+if __name__ == "__main__":
+    main()

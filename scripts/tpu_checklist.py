@@ -25,6 +25,12 @@ Steps (each standalone, continues past failures):
      with the esc reference, and the forced hash/dense runs must show
      their variant-suffixed window dispatches on the ledger — proving
      the selector routes before any chip time is spent.
+  0e. (--mesh) scale-out smoke on a 2x2 submesh: the serve bits path
+     must resolve (not fall back) on a routed square mesh, the mesh
+     packed-bit batch must match the dense batch, and the hybrid
+     SUMMA exchange must reproduce the forced-dense product
+     bit-exactly with its sparse broadcasts on the ledger. Skips when
+     fewer than 4 devices are attached.
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -252,6 +258,98 @@ def run_esc_check(grid) -> bool:
     return ok
 
 
+def run_mesh_check() -> bool:
+    """Step 0e: scale-out smoke on a 2x2 submesh — the serve bits
+    path must resolve (not fall back) on a routed square mesh, the
+    mesh packed-bit batch must match the dense batch's visited sets,
+    and a hybrid-exchange SpGEMM must reproduce the forced-dense
+    product bit-exactly with its `spgemm.bcast/sparse` broadcasts on
+    the ledger. Skips (OK) when fewer than 4 devices are attached."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm, spgemm as spg
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    step("0e. scale-out mesh smoke (--mesh)")
+    devs = jax.devices()
+    if len(devs) < 4:
+        print(f"SKIP: {len(devs)} device(s) attached, mesh smoke "
+              "needs 4 (2x2)")
+        return True
+    ok = True
+    mesh = ProcGrid.make(2, 2, devs[:4])
+    n = 1 << 9
+    r, c = generate.rmat_edges(jax.random.key(5), 9, 8)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, mesh, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    try:
+        plan = B.plan_bfs(a, route=True)
+        reason = B.bits_fallback_reason(a, plan)
+        if reason is not None:
+            print(f"FAIL: bits path fell back on the 2x2 mesh "
+                  f"(reason={reason})")
+            ok = False
+        else:
+            roots = jnp.arange(8, dtype=jnp.int32)
+            mvb, lvl, done = B.bfs_batch_bits_mesh(a, roots, plan=plan)
+            mvd, _, _ = B.bfs_batch(a, roots, plan=plan)
+            if not np.array_equal(np.asarray(mvb.to_global()) >= 0,
+                                  np.asarray(mvd.to_global()) >= 0):
+                print("FAIL: mesh bits visited sets != dense batch")
+                ok = False
+            print(f"  mesh bits batch: levels={np.asarray(lvl).tolist()}"
+                  f" done={bool(np.asarray(done).all())}")
+
+        af = a.astype(jnp.float32)
+        saved = os.environ.get("COMBBLAS_TPU_BCAST_VARIANT")
+        outs, ledgers = {}, {}
+        try:
+            for mode in ("dense", "sparse"):
+                os.environ["COMBBLAS_TPU_BCAST_VARIANT"] = mode
+                obs.reset()
+                obs.ledger.LEDGER.reset()
+                obs.set_enabled(True)
+                try:
+                    cm = spg.spgemm(S.PLUS_TIMES_F32, af, af)
+                    cm.vals.block_until_ready()
+                    outs[mode] = cm
+                    ledgers[mode] = sorted(
+                        {x.name for x in obs.ledger.LEDGER.snapshot()
+                         if x.name.startswith("spgemm.bcast")})
+                finally:
+                    obs.set_enabled(False)
+                    obs.reset()
+                    obs.ledger.LEDGER.reset()
+                print(f"  {mode}: c_nnz={outs[mode].getnnz()} "
+                      f"bcasts={ledgers[mode]}")
+        finally:
+            if saved is None:
+                os.environ.pop("COMBBLAS_TPU_BCAST_VARIANT", None)
+            else:
+                os.environ["COMBBLAS_TPU_BCAST_VARIANT"] = saved
+        for f in ("rows", "cols", "vals", "nnz"):
+            if not np.array_equal(np.asarray(getattr(outs["dense"], f)),
+                                  np.asarray(getattr(outs["sparse"], f))):
+                print(f"FAIL: hybrid exchange diverged from dense ({f})")
+                ok = False
+        if not any(nm.startswith("spgemm.bcast/sparse")
+                   for nm in ledgers["sparse"]):
+            print(f"FAIL: forced sparse exchange never recorded "
+                  f"spgemm.bcast/sparse (ledger: {ledgers['sparse']})")
+            ok = False
+    except Exception:
+        traceback.print_exc()
+        return False
+    print("mesh smoke:", "OK" if ok else "FAILED")
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="on-chip validation + perf checklist")
@@ -272,6 +370,12 @@ def main():
                          "under each COMBBLAS_TPU_LOCAL_VARIANT value; "
                          "all variants must match the esc reference "
                          "bit-exactly")
+    ap.add_argument("--mesh", action="store_true",
+                    help="scale-out smoke on a 2x2 submesh: serve "
+                         "bits path resolves, mesh packed-bit batch "
+                         "matches the dense batch, hybrid SUMMA "
+                         "exchange bit-exact vs forced dense (skips "
+                         "when <4 devices)")
     args = ap.parse_args()
     if args.analysis and not run_analysis_gate():
         sys.exit(1)
@@ -294,6 +398,8 @@ def main():
     if args.mcl and not run_mcl_check(grid):
         sys.exit(1)
     if args.esc and not run_esc_check(grid):
+        sys.exit(1)
+    if args.mesh and not run_mesh_check():
         sys.exit(1)
 
     step("1. pallas scan on-chip")

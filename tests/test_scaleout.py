@@ -1,0 +1,261 @@
+"""Communication-avoiding scale-out paths: hybrid sparse/dense SUMMA
+exchange (bit-exact vs forced-dense across semirings), mesh batched
+bitplane BFS parity on a 2x2 routed grid, fallback observability, and
+the tall-and-skinny SpMM schedule."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.models import bfs as B
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import densemat as DMM
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import spgemm as SPG
+from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS, ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid22():
+    return ProcGrid.make(2, 2, jax.devices()[:4])
+
+
+@pytest.fixture(scope="module")
+def grid24():
+    return ProcGrid.make(2, 4, jax.devices())
+
+
+def _rmat(grid, scale=8, ef=8, seed=0, dtype=None):
+    n = 1 << scale
+    r, c = generate.rmat_edges(jax.random.key(seed), scale, ef)
+    r, c = generate.symmetrize(r, c)
+    a = DM.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    a = a.astype(dtype) if dtype is not None else a
+    return a, np.asarray(r), np.asarray(c)
+
+
+class TestHybridExchange:
+    @pytest.mark.parametrize("sr,dtype", [
+        (S.PLUS_TIMES_F32, jnp.float32),
+        (S.MIN_PLUS_F32, jnp.float32),
+        (S.BOOL_OR_AND, None),                 # bool vals: LOR graph
+    ], ids=["plus_times", "min_plus", "bool_or_and"])
+    def test_bit_exact_vs_forced_dense(self, grid24, monkeypatch,
+                                       sr, dtype):
+        """The sparse exchange ships a lossless nnz-prefix, so every
+        variant must reproduce the forced-dense result bit-for-bit:
+        identical rows/cols/vals arrays, not just identical values."""
+        a, _, _ = _rmat(grid24, dtype=dtype)
+        outs = {}
+        for variant in ("dense", "sparse", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_BCAST_VARIANT", variant)
+            outs[variant] = SPG.spgemm(sr, a, a)
+        ref = outs["dense"]
+        assert ref.getnnz() > 0
+        for variant in ("sparse", "auto"):
+            c = outs[variant]
+            for f in ("rows", "cols", "vals", "nnz"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ref, f)),
+                    np.asarray(getattr(c, f)),
+                    err_msg=f"{variant}.{f}")
+
+    def test_plan_modes_and_threshold(self, grid24, monkeypatch):
+        monkeypatch.delenv("COMBBLAS_TPU_BCAST_VARIANT", raising=False)
+        a, _, _ = _rmat(grid24, dtype=jnp.float32)
+        cap = a.rows.shape[-1]
+        dense = SPG.plan_bcast(a, a, mode="dense")
+        assert all(st == ("dense", cap, "dense", cap) for st in dense)
+        sparse = SPG.plan_bcast(a, a, mode="sparse")
+        assert any(k < cap for st in sparse for k in (st[1], st[3]))
+        assert all(v == "sparse" for st in sparse for v in (st[0], st[2])
+                   if st[1] < cap and st[3] < cap)
+        # rungs never exceed the tile capacity and cover the sources
+        annz = np.asarray(a.nnz)
+        for (lo, hi, ja, la, ib, lb), st in zip(
+                SPG._summa_intervals(a, a), sparse):
+            assert st[1] <= cap and st[1] >= annz[:, ja].max()
+            assert st[3] <= cap and st[3] >= annz[ib, :].max()
+        # threshold 0 -> auto never goes sparse; 1.0 -> sparse
+        # whenever the rung is below capacity
+        assert SPG.plan_bcast(a, a, threshold=0.0) == dense
+        assert SPG.plan_bcast(a, a, threshold=1.0) == sparse
+
+    def test_round_bytes_and_plan_validation(self, grid24):
+        a, _, _ = _rmat(grid24, dtype=jnp.float32)
+        plan = SPG.plan_bcast(a, a, mode="sparse")
+        rb = SPG.bcast_round_bytes(a, a, plan=plan)
+        assert rb["hybrid_bytes"] < rb["dense_bytes"]
+        assert rb["bcasts"]["sparse"] > 0
+        alldense = SPG.bcast_round_bytes(
+            a, a, plan=SPG.plan_bcast(a, a, mode="dense"))
+        assert alldense["hybrid_bytes"] == alldense["dense_bytes"]
+        # a plan whose length disagrees with the stage structure must
+        # be rejected before it silently misaligns the exchange
+        fc, oc = SPG.plan_spgemm(a, a)
+        with pytest.raises(ValueError):
+            SPG.summa(S.PLUS_TIMES_F32, a, a, flops_cap=fc, out_cap=oc,
+                      bcast_plan=plan + plan[:1])
+
+    def test_env_mode_validation(self, monkeypatch):
+        monkeypatch.setenv("COMBBLAS_TPU_BCAST_VARIANT", "bogus")
+        with pytest.raises(ValueError, match="COMBBLAS_TPU_BCAST"):
+            SPG.bcast_variant_mode()
+
+
+@pytest.fixture(scope="module")
+def mesh_bits_setup(grid22):
+    a, rn, cn = _rmat(grid22, scale=8, ef=8, seed=0)
+    plan = B.plan_bfs(a, route=True)
+    assert B.bits_fallback_reason(a, plan) is None
+    deg = np.zeros(a.nrows, np.int64)
+    np.add.at(deg, rn, 1)
+    isolated = np.nonzero(deg == 0)[0]
+    assert isolated.size, "toy graph should have isolated vertices"
+    # duplicate root 0, one isolated root, a spread of connected ones
+    roots = np.array([0, 5, 17, 0, int(isolated[0]), 33, 129, 64],
+                     np.int32)
+    return a, plan, roots
+
+
+def _chase_levels(par, root):
+    """Per-vertex level = parent-chain length to the root (asserts
+    acyclicity); -1 where unreached."""
+    n = par.shape[0]
+    lev = np.full(n, -1, np.int64)
+    for v in np.nonzero(par >= 0)[0]:
+        x, hops, seen = v, 0, set()
+        while x != root:
+            assert x not in seen and hops <= n, "parent cycle"
+            seen.add(x)
+            x = int(par[x])
+            hops += 1
+        lev[v] = hops
+    return lev
+
+
+class TestMeshBitsBatch:
+    def test_parity_vs_dense_and_per_root(self, mesh_bits_setup):
+        """32-roots-per-word batch on the routed 2x2 mesh: visited
+        sets match the dense-column batch AND per-root `bfs()`;
+        parent-chase levels are bit-exact per lane (parent CHOICES may
+        differ); duplicate lanes agree; the isolated root terminates
+        at level 0 with only itself visited."""
+        a, plan, roots = mesh_bits_setup
+        mvb, lvlb, doneb = B.bfs_batch_bits_mesh(a, roots, plan=plan)
+        mvd, _, _ = B.bfs_batch(a, roots, plan=plan)
+        pb = np.asarray(mvb.to_global())
+        pd = np.asarray(mvd.to_global())
+        lvlb, doneb = np.asarray(lvlb), np.asarray(doneb)
+        assert lvlb.shape == roots.shape and doneb.all()
+        np.testing.assert_array_equal(pb >= 0, pd >= 0)
+        for k, r in enumerate(roots):
+            ps = np.asarray(B.bfs(a, jnp.int32(int(r))).to_global())
+            np.testing.assert_array_equal(pb[:, k] >= 0, ps >= 0,
+                                          err_msg=f"lane {k} root {r}")
+            lv = _chase_levels(pb[:, k], int(r))
+            np.testing.assert_array_equal(lv, _chase_levels(ps, int(r)),
+                                          err_msg=f"lane {k} root {r}")
+            assert lvlb[k] == lv.max(), f"lane {k} reported level"
+        # duplicate roots (lanes 0 and 3) must produce identical lanes
+        np.testing.assert_array_equal(pb[:, 0], pb[:, 3])
+        # isolated root: visits only itself, done at level 0
+        iso = 4
+        assert lvlb[iso] == 0
+        assert (pb[:, iso] >= 0).sum() == 1
+        assert pb[roots[iso], iso] == roots[iso]
+
+    def test_partial_max_levels(self, mesh_bits_setup):
+        a, plan, roots = mesh_bits_setup
+        mv1, lvl1, done1 = B.bfs_batch_bits_mesh(a, roots, max_levels=1,
+                                                 plan=plan)
+        dm1, dlvl1, ddone1 = B.bfs_batch(a, roots, max_levels=1,
+                                         plan=plan)
+        p1 = np.asarray(mv1.to_global())
+        np.testing.assert_array_equal(p1 >= 0,
+                                      np.asarray(dm1.to_global()) >= 0)
+        lvl1, done1 = np.asarray(lvl1), np.asarray(done1)
+        assert lvl1.max() <= 1
+        # non-isolated roots still have frontier waiting; the isolated
+        # one (lane 4) is genuinely done at level 0
+        np.testing.assert_array_equal(done1, np.asarray(ddone1))
+        assert bool(done1[4]) and not done1[0]
+        for k, r in enumerate(roots):
+            assert p1[r, k] == r     # root is its own parent
+
+    def test_dispatcher_routes_mesh(self, mesh_bits_setup):
+        """`bfs_batch_bits` with a routed square-mesh plan must take
+        the mesh core (identical output incl. per-lane levels), not
+        the dense fallback, and record no fallback."""
+        a, plan, roots = mesh_bits_setup
+        before = {r: B._M_BITS_FALLBACK.value(kind=r)
+                  for r in B.BITS_FALLBACK_REASONS}
+        mv, lvl, done = B.bfs_batch_bits(a, roots, plan=plan)
+        ref, rlvl, rdone = B.bfs_batch_bits_mesh(a, roots, plan=plan)
+        np.testing.assert_array_equal(np.asarray(mv.data),
+                                      np.asarray(ref.data))
+        np.testing.assert_array_equal(np.asarray(lvl), np.asarray(rlvl))
+        np.testing.assert_array_equal(np.asarray(done),
+                                      np.asarray(rdone))
+        after = {r: B._M_BITS_FALLBACK.value(kind=r)
+                 for r in B.BITS_FALLBACK_REASONS}
+        assert after == before
+
+    def test_fallback_reason_observable(self, mesh_bits_setup):
+        """Silent degradation to the dense batch is not silent: the
+        `bfs.bits_fallback` counter gains the reason label."""
+        from combblas_tpu.obs import trace
+        a, plan, roots = mesh_bits_setup
+        assert B.bits_fallback_reason(a, None) == "unrouted"
+        was = trace.enabled()
+        trace.set_enabled(True)
+        try:
+            before = B._M_BITS_FALLBACK.value(kind="unrouted")
+            mv, lvl, done = B.bfs_batch_bits(a, roots, plan=None)
+            assert B._M_BITS_FALLBACK.value(kind="unrouted") == before + 1
+        finally:
+            trace.set_enabled(was)
+        # fallback output is the dense batch with broadcast levels
+        dmv, dlvl, _ = B.bfs_batch(a, roots)
+        np.testing.assert_array_equal(np.asarray(mv.to_global()),
+                                      np.asarray(dmv.to_global()))
+        np.testing.assert_array_equal(np.asarray(lvl),
+                                      np.full(len(roots), int(dlvl)))
+
+
+class TestSpmmTall:
+    @pytest.mark.parametrize("sr", [S.PLUS_TIMES_F32, S.MIN_PLUS_F32],
+                             ids=["plus_times", "min_plus"])
+    def test_bit_exact_vs_col_aligned(self, grid22, rng, sr):
+        """The tall schedule (one A-panel ppermute amortized over all
+        batched columns) reorders no reduction: bit-exact vs the
+        COL-aligned `spmm`."""
+        a, _, _ = _rmat(grid22, dtype=jnp.float32)
+        x = rng.random((a.ncols, 7)).astype(np.float32)
+        xc = DMM.mv_from_global(a.grid, COL_AXIS, x, block=a.tile_n)
+        xr = DMM.mv_from_global(a.grid, ROW_AXIS, x, block=a.tile_n)
+        yc = np.asarray(DMM.spmm(sr, a, xc).to_global())
+        yr = np.asarray(DMM.spmm_tall(sr, a, xr).to_global())
+        np.testing.assert_array_equal(yc, yr)
+
+    def test_col_aligned_passthrough(self, grid22, rng):
+        a, _, _ = _rmat(grid22, dtype=jnp.float32)
+        x = rng.random((a.ncols, 3)).astype(np.float32)
+        xc = DMM.mv_from_global(a.grid, COL_AXIS, x, block=a.tile_n)
+        np.testing.assert_array_equal(
+            np.asarray(DMM.spmm_tall(S.PLUS_TIMES_F32, a, xc).to_global()),
+            np.asarray(DMM.spmm(S.PLUS_TIMES_F32, a, xc).to_global()))
+
+    def test_nonsquare_grid_realigns(self, grid24, rng):
+        """On a non-square mesh the single-ppermute trick has no
+        transpose pairing: spmm_tall must realign and still agree."""
+        a, _, _ = _rmat(grid24, dtype=jnp.float32)
+        x = rng.random((a.ncols, 5)).astype(np.float32)
+        xc = DMM.mv_from_global(a.grid, COL_AXIS, x, block=a.tile_n)
+        xr = DMM.mv_from_global(a.grid, ROW_AXIS, x)
+        np.testing.assert_array_equal(
+            np.asarray(DMM.spmm_tall(S.PLUS_TIMES_F32, a, xr).to_global()),
+            np.asarray(DMM.spmm(S.PLUS_TIMES_F32, a, xc).to_global()))
