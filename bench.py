@@ -51,6 +51,10 @@ def bench_bfs(args):
     s["window_times_s"] = [round(t, 4) for t in stats.window_times]
     s["window_sizes"] = stats.window_sizes
     s["dispatch_summary"] = obs.dispatch_summary()
+    # roofline headline: the cost-model join's wall-weighted verdict
+    # (same block nested in dispatch_summary — hoisted so trend
+    # tooling greps one stable key)
+    s["roofline"] = s["dispatch_summary"].get("efficiency")
     return s
 
 
@@ -141,6 +145,7 @@ def bench_spgemm(args):
             "unaccounted_s": round(breakdown["unaccounted"], 4),
             "spans": spgemm_spans, "metrics": spgemm_metrics,
             "dispatch_summary": spgemm_dispatches,
+            "roofline": spgemm_dispatches.get("efficiency"),
             "spmsv_phases": spmsv_phases,
             "phases_note": "phase attribution requires a device sync "
                            "per phase; on a tunneled TPU each sync "
@@ -229,6 +234,7 @@ def bench_mcl(args):
     dt = time.perf_counter() - t0
     obs.set_enabled(False)
     breakdown = obs.export.phase_breakdown()
+    dispatches = obs.dispatch_summary()
     return {"scale": args.mcl_scale, "n": n, "nnz": a.getnnz(),
             "planted_clusters": nclust, "found_clusters": nclusters,
             "iterations": iters, "seconds": round(dt, 3),
@@ -237,7 +243,8 @@ def bench_mcl(args):
             "unaccounted_s": round(breakdown["unaccounted"], 4),
             "spans": obs.export.report(),
             "metrics": obs.REGISTRY.snapshot(),
-            "dispatch_summary": obs.dispatch_summary()}
+            "dispatch_summary": dispatches,
+            "roofline": dispatches.get("efficiency")}
 
 
 def main():
@@ -351,6 +358,7 @@ def main():
                 "spans": sp["spans"],
                 "metrics": sp["metrics"],
                 "dispatch_summary": sp["dispatch_summary"],
+                "roofline": sp["roofline"],
                 "spmsv_phases": sp["spmsv_phases"],
                 "note": f"largest single-chip scale whose full C fits "
                         f"HBM is {sp['scale']} (baseline metric names "
@@ -379,7 +387,7 @@ def main():
                                       "found_clusters", "iterations",
                                       "phase_breakdown", "unaccounted_s",
                                       "spans", "metrics",
-                                      "dispatch_summary")},
+                                      "dispatch_summary", "roofline")},
             })
         except Exception as e:
             extra.append({"metric": "mcl_bench_error", "error": str(e)})
@@ -422,6 +430,7 @@ def main():
         "window_times_s": s["window_times_s"],
         "window_sizes": s["window_sizes"],
         "dispatch_summary": s["dispatch_summary"],
+        "roofline": s["roofline"],
         "timing": f"{s['n_windows']} timing windows; each window's "
                   "roots dispatched back-to-back with async stats "
                   "readback, wall time = [first dispatch, last "

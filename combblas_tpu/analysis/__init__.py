@@ -1,7 +1,7 @@
 """`combblas_tpu.analysis` — static-analysis gate for the repo's
 structural invariants.
 
-Four passes, one verdict (see `scripts/analyze.py --gate` and the
+Five passes, one verdict (see `scripts/analyze.py --gate` and the
 README "Static analysis" section):
 
 1. **Budget engine** (`budget.run_budgets`) — lowers registered
@@ -24,6 +24,12 @@ README "Static analysis" section):
    wall, dispatch counts at artifact paths (e.g. the bits-BFS
    512-query burst), per-executable ledger counts, and required
    instrumentation coverage (`ledger_names`).
+5. **perf-regression gate** (`perfgate.run_perf`) — the committed
+   `BENCH_TRAJECTORY.json` (built by `scripts/bench_registry.py` from
+   every bench artifact via `obs.regress`) held against
+   `budgets/perf_regression.json`: trajectory coverage/staleness,
+   roofline-efficiency floors on schema-full runs, and direction-aware
+   noise bands around each workload's newest-vs-baseline runs.
 
 All passes are trace/AST/JSON only — nothing here compiles or
 executes device code — and every finding carries `file:line`, a rule
@@ -58,7 +64,12 @@ def run_obs(**kw):
     return obsbudget.run_obs(**kw)
 
 
-def run_all(passes=("budgets", "retrace", "locks", "obs")) \
+def run_perf(**kw):
+    from combblas_tpu.analysis import perfgate
+    return perfgate.run_perf(**kw)
+
+
+def run_all(passes=("budgets", "retrace", "locks", "obs", "perf")) \
         -> list[Finding]:
     """Run the selected passes; returns all unsuppressed findings
     (empty = gate passes)."""
@@ -71,4 +82,6 @@ def run_all(passes=("budgets", "retrace", "locks", "obs")) \
         out += run_lockorder()
     if "obs" in passes:
         out += run_obs()
+    if "perf" in passes:
+        out += run_perf()
     return out

@@ -48,11 +48,21 @@ OBS_DISPATCH_COUNT = "obs-dispatch-count"  # dispatch count over ceiling
 OBS_STALE = "obs-stale-artifact"         # budget names an artifact/path/
 #                                          executable that no longer exists
 
+# perf-regression gate over the bench trajectory (pass 5)
+PERF_EFFICIENCY = "perf-efficiency-floor"   # roofline eff / attributable
+#                                             fraction below committed floor
+PERF_REGRESSION = "perf-regression-band"    # newest run outside the noise
+#                                             band around the baseline
+PERF_STALE = "perf-stale-trajectory"        # BENCH_TRAJECTORY.json missing,
+#                                             unreadable, or not covering a
+#                                             committed artifact
+
 ALL_RULES = (
     SORT_COUNT, SORT_ARITY, OP_CEILING, FORBID_DTYPE, FORBID_OP,
     LANE_INVARIANCE, RETRACE_DRIFT, RETRACE_PY_SCALAR,
     RETRACE_EXTRA_COMPILE, LOCK_CYCLE, JIT_UNDER_LOCK, BARE_ACQUIRE,
     OBS_RESIDUAL, OBS_DISPATCH_COUNT, OBS_STALE,
+    PERF_EFFICIENCY, PERF_REGRESSION, PERF_STALE,
 )
 
 

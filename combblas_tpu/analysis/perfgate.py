@@ -1,0 +1,168 @@
+"""Pass 5 — perf-regression gate over the committed bench trajectory.
+
+The obs-residual pass (pass 4) pins *attribution* — how much of the
+wall the recorder explains. This pass pins *performance itself*: the
+canonical trajectory `scripts/bench_registry.py` builds from every
+committed bench artifact (`obs.regress`) is held against declarative
+floors and noise bands in `analysis/budgets/perf_regression.json`:
+
+* **staleness** — the committed `BENCH_TRAJECTORY.json` must exist,
+  parse against the canonical schema, and carry a run row for EVERY
+  committed artifact the registry globs recognize. A bench landed
+  without regenerating the trajectory is a gate failure, not a
+  silently-shrinking baseline.
+* **efficiency floors** — schema-`full` runs (the only ones that
+  carry the cost-model join) must meet `min_attributable_frac` /
+  `min_efficiency`. Null values are skipped (pre-PR-10 artifacts
+  never crash the gate), so the floor bites exactly when a fresh
+  instrumented run regresses its roofline verdict.
+* **regression bands** — each workload's newest run (highest seq) is
+  compared against the direction-aware best of its prior runs through
+  `obs.regress.compare`: a `higher` metric (GTEPS) failing below
+  baseline*(1-band) or a `lower` metric (wall) rising above
+  baseline*(1+band) fails the gate.
+
+Budget JSON shape (one object per file)::
+
+    {"trajectory": "BENCH_TRAJECTORY.json",
+     "efficiency_floors": [{"workload": "*", "schemas": ["full"],
+                            "min_attributable_frac": 0.5,
+                            "min_efficiency": 0.01}],
+     "bands": [{"workload": "mcl", "metric": "wall_s",
+                "direction": "lower", "band_frac": 0.5}],
+     "allow": []}                      # waived rule ids
+
+Everything here is pure JSON reads — nothing compiles or runs device
+code.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+from combblas_tpu.obs import regress
+
+BUDGET_DIR = pathlib.Path(__file__).parent / "budgets"
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def _line_of(text: str, key: str) -> int:
+    """Line of the first occurrence of ``key`` in the budget file, so
+    findings point at the violated number."""
+    for i, ln in enumerate(text.splitlines()):
+        if f'"{key}"' in ln:
+            return i + 1
+    return 1
+
+
+def check_floors(data: dict, traj: dict) -> list:
+    """(key, message) efficiency-floor violations — the unit the
+    self-test fixture drives."""
+    out = []
+    for floor in data.get("efficiency_floors", ()):
+        wl = floor.get("workload", "*")
+        schemas = tuple(floor.get("schemas", ("full",)))
+        for run in traj.get("runs", ()):
+            if wl not in ("*", run.get("workload")):
+                continue
+            if run.get("schema") not in schemas:
+                continue
+            for metric, key in (("attributable_frac",
+                                 "min_attributable_frac"),
+                                ("efficiency", "min_efficiency")):
+                floor_v = floor.get(key)
+                v = run.get(metric)
+                if floor_v is None or v is None:
+                    continue   # pre-PR-10 runs carry no join: skip
+                if float(v) < float(floor_v):
+                    out.append((key, (
+                        f"{run['run_id']}: {metric}={float(v):g} below "
+                        f"the committed floor {float(floor_v):g} — the "
+                        "roofline verdict regressed (see the artifact's "
+                        "dispatch_summary.efficiency block)")))
+    return out
+
+
+def check_bands(data: dict, traj: dict) -> list:
+    """(key, message) regression-band violations: newest run per
+    workload vs the direction-aware baseline of its prior runs."""
+    bands = data.get("bands")
+    out = []
+    for wl, run in sorted(regress.newest_runs(traj).items()):
+        try:
+            violations = regress.compare(run, traj, bands)
+        except regress.SchemaError as e:
+            out.append(("bands", f"{wl}: {e}"))
+            continue
+        for v in violations:
+            out.append(("band_frac", v["message"]))
+    return out
+
+
+def check_coverage(traj: dict, root: pathlib.Path) -> list:
+    """(key, message) staleness findings: committed artifacts the
+    trajectory does not cover."""
+    covered = {r.get("artifact") for r in traj.get("runs", ())}
+    out = []
+    seen = set()
+    for pat, _wl in regress.ARTIFACT_GLOBS:
+        for p in sorted(root.glob(pat)):
+            if p.name in seen:
+                continue
+            seen.add(p.name)
+            if p.name not in covered:
+                out.append(("trajectory", (
+                    f"{p.name} has no run row in the committed "
+                    "trajectory — regenerate with "
+                    "scripts/bench_registry.py")))
+    return out
+
+
+def check_budget(data: dict, budget_text: str, budget_path: str,
+                 root=None) -> list[Finding]:
+    """All findings for one perf budget file."""
+    allow = set(data.get("allow", []))
+    root = pathlib.Path(root or REPO_ROOT)
+    findings: list[Finding] = []
+
+    def add(rule, key, msg):
+        if rule not in allow:
+            findings.append(Finding(
+                rule, budget_path, _line_of(budget_text, key), msg,
+                entry="perf"))
+
+    tr_name = data.get("trajectory", "BENCH_TRAJECTORY.json")
+    tr_path = root / tr_name
+    if not tr_path.exists():
+        add(core.PERF_STALE, "trajectory",
+            f"trajectory {tr_name!r} not found — run "
+            "scripts/bench_registry.py to generate it")
+        return findings
+    try:
+        traj = regress.load_trajectory(tr_path)
+    except regress.SchemaError as e:
+        add(core.PERF_STALE, "trajectory", f"unusable trajectory: {e}")
+        return findings
+    for key, msg in check_coverage(traj, root):
+        add(core.PERF_STALE, key, msg)
+    for key, msg in check_floors(data, traj):
+        add(core.PERF_EFFICIENCY, key, msg)
+    for key, msg in check_bands(data, traj):
+        add(core.PERF_REGRESSION, key, msg)
+    return findings
+
+
+def run_perf(files=None, root=None) -> list[Finding]:
+    """Run the perf-regression gate over the committed budgets (or an
+    explicit fixture list); returns unsuppressed findings."""
+    paths = ([pathlib.Path(f) for f in files] if files is not None
+             else sorted(BUDGET_DIR.glob("perf_*.json")))
+    findings: list[Finding] = []
+    for p in paths:
+        text = p.read_text()
+        data = json.loads(text)
+        findings += check_budget(data, text, str(p), root=root)
+    return findings

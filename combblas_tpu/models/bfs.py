@@ -181,6 +181,10 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
     ``route="auto"`` enables it only when the estimated planning time
     fits ``route_budget_s`` (calibrated ~60ns per slot-depth on one
     host core)."""
+    # plan time is the one place the host already knows nnz: register
+    # the nnz-proportional roofline costs of every bfs.*/spmv.* ledger
+    # name so traversal dispatch walls grade against expected work
+    obs.costmodel.annotate_matrix(a)
     plan = _plan_bfs_core(a)
     if not route:
         return plan

@@ -287,6 +287,29 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
         return _mcl_instrumented(a, params, verbose, cap_ladder)
 
 
+#: per-nnz (flops, local bytes) models for the mcl.* ledger names —
+#: pass counts over the 12-byte COO slots each executable streams
+#: (megastep = repin + inflate + stochastic + chaos fused)
+_MCL_COSTS = {
+    "mcl.make_col_stochastic": (2.0, 24.0),
+    "mcl.inflate": (4.0, 24.0),
+    "mcl.chaos_dev": (4.0, 12.0),
+    "mcl.repin": (0.0, 24.0),
+    "mcl.megastep": (8.0, 48.0),
+    "mcl.prune_select_recover": (8.0, 60.0),
+}
+
+
+def _annotate_mcl_costs(nnz: int) -> None:
+    """Cost-model registration for one MCL run, from the post-setup
+    nnz (prune shrinks nnz monotonically, so this is a per-call upper
+    bound — efficiency reads as a floor)."""
+    for name, (f, lb) in _MCL_COSTS.items():
+        obs.costmodel.annotate(name, flops=f * nnz, lbytes=lb * nnz)
+    obs.costmodel.annotate("mcl.cap_readback", lbytes=4.0)
+    obs.costmodel.annotate("mcl.chaos_deferred", lbytes=4.0)
+
+
 def _mcl_instrumented(a, params, verbose, cap_ladder=None):
     # span taxonomy per iteration (≅ MCL.cpp's printed per-iteration
     # stats): `mcl_expand` is structural — its children are the phased
@@ -298,6 +321,7 @@ def _mcl_instrumented(a, params, verbose, cap_ladder=None):
         a = alg.add_loops(a, 1.0)
         a = make_col_stochastic(a)
         obs.sync(a.vals)
+    _annotate_mcl_costs(a.getnnz())
     hook = partial(mcl_prune_select_recover, p=params)
     nproc = a.grid.pr * a.grid.pc
     # ONE capacity ladder for the whole run: iteration 1 (the largest —

@@ -9,7 +9,14 @@ accumulators. This subsystem supersedes them:
 * `obs.metrics` — labeled counters/gauges/histograms (nnz, flops,
   bytes read back, compile-cache hits, phase counts);
 * `obs.export` — report tree, JSON-lines log, Chrome-trace/Perfetto
-  emitter, and the `jax.profiler` bridge.
+  emitter, and the `jax.profiler` bridge;
+* `obs.costmodel` — roofline cost-model registry: planners annotate
+  ledger names with expected flops/bytes at plan time; `top_k` /
+  `format_table` / `/varz` join them into achieved FLOP/s, B/s, and
+  efficiency fractions against `utils.config.backend_peaks`;
+* `obs.regress` — canonical bench trajectory (BENCH_TRAJECTORY.json)
+  normalizers and the noise-banded regression detector behind
+  `scripts/bench_registry.py` and analysis pass 5.
 
 Everything is gated on ONE process-wide flag (`set_enabled`, the same
 contract as the old `timing._ENABLED`): disabled call sites cost one
@@ -27,7 +34,9 @@ Quick start::
     obs.export.chrome_trace("trace.json")    # open in ui.perfetto.dev
 """
 
-from combblas_tpu.obs import export, httpd, ledger, metrics, timeline, trace
+from combblas_tpu.obs import (
+    costmodel, export, httpd, ledger, metrics, regress, timeline, trace,
+)
 from combblas_tpu.obs.trace import (
     CATEGORIES, TRACER, Tracer, current_path, enabled, get_trace_id,
     new_trace_id, reset, set_enabled, set_trace_id, span, sync, traced,

@@ -53,12 +53,18 @@ def unaccounted_s(tracer: Tracer | None = None) -> float:
 
 def dispatch_summary(k: int = 10, ledger=None) -> dict:
     """The BENCH-artifact block next to `phase_breakdown`: top-K
-    executables by total wall from the dispatch ledger, plus totals.
-    {top: [{name, count, total_s, mean_s, compiles, ...}], dispatches,
-    readbacks, compiles, recorded, dropped}."""
+    executables by total wall from the dispatch ledger (with the
+    roofline cost-model join on each row), plus totals and the
+    aggregate `efficiency` verdict {attributable_frac, eff,
+    bound_wall_s, backend} that `obs.regress` folds into the bench
+    trajectory. {top: [...], dispatches, readbacks, compiles,
+    recorded, dropped, efficiency}."""
+    from combblas_tpu.obs import costmodel as _costmodel
     from combblas_tpu.obs import ledger as _ledger
     led = ledger if ledger is not None else _ledger.LEDGER
     recs = led.snapshot()
+    all_rows = _ledger.top_k(1 << 20, by="wall", records=recs,
+                             join_costs=False)
     return {
         "top": _ledger.top_k(k, by="wall", records=recs),
         "dispatches": sum(1 for r in recs if r.kind == "dispatch"),
@@ -66,6 +72,7 @@ def dispatch_summary(k: int = 10, ledger=None) -> dict:
         "compiles": sum(1 for r in recs if r.compiled),
         "recorded": led.total,
         "dropped": led.dropped,
+        "efficiency": _costmodel.efficiency_summary(rows=all_rows),
     }
 
 

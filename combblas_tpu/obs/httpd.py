@@ -170,10 +170,36 @@ def parse_prometheus(text: str) -> dict:
     return series
 
 
+def refresh_obs_gauges() -> None:
+    """Publish the ledger's own health as metrics, refreshed at scrape
+    time: `obs.ledger_dropped` (ring-overflow count — non-zero means
+    the recorder silently truncated and dispatch counts under-report),
+    `obs.ledger_total`, `obs.ledger_capacity`,
+    `obs.instrumented_registry_size`, and
+    `obs.costmodel_registry_size` (annotated-name count)."""
+    from combblas_tpu.obs import costmodel as _costmodel
+    led = _ledger.LEDGER
+    _metrics.gauge("obs.ledger_dropped",
+                   "dispatch records lost to ring wrap").set(led.dropped)
+    _metrics.gauge("obs.ledger_total",
+                   "dispatch records ever written").set(led.total)
+    _metrics.gauge("obs.ledger_capacity",
+                   "dispatch ring capacity").set(led.capacity)
+    _metrics.gauge("obs.instrumented_registry_size",
+                   "instrumented executable names").set(
+        len(_ledger.INSTRUMENTED))
+    _metrics.gauge("obs.costmodel_registry_size",
+                   "ledger names with cost annotations").set(
+        _costmodel.registry_size())
+
+
 def varz_snapshot(extra=None, top_k: int = 10) -> dict:
-    """JSON-ready full snapshot: metrics registry + ledger top-K +
-    whatever the hosting service adds via `extra()` (e.g. GraphService
-    stats/plan-cache hit rates)."""
+    """JSON-ready full snapshot: metrics registry + ledger top-K (with
+    the roofline join) + cost-model coverage + whatever the hosting
+    service adds via `extra()` (e.g. GraphService stats/plan-cache hit
+    rates)."""
+    from combblas_tpu.obs import costmodel as _costmodel
+    refresh_obs_gauges()
     led = _ledger.LEDGER
     out = {
         "ts": time.time(),
@@ -184,6 +210,11 @@ def varz_snapshot(extra=None, top_k: int = 10) -> dict:
             "capacity": led.capacity,
             "top": _ledger.top_k(top_k),
             "instrumented": sorted(_ledger.INSTRUMENTED),
+            "instrumented_count": len(_ledger.INSTRUMENTED),
+        },
+        "costmodel": {
+            "registry_size": _costmodel.registry_size(),
+            "efficiency": _costmodel.efficiency_summary(),
         },
     }
     if extra is not None:
@@ -218,10 +249,12 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                            b"ok\n" if healthy else b"unhealthy\n",
                            "text/plain; charset=utf-8")
             elif path == "/metrics":
+                self._refresh()
                 body = prometheus_text().encode()
                 self._send(200, body,
                            "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/varz":
+                self._refresh(skip_obs=True)   # varz_snapshot refreshes
                 body = json.dumps(varz_snapshot(self.server.varz_fn),
                                   indent=1, default=str).encode()
                 self._send(200, body, "application/json")
@@ -229,6 +262,19 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(404, b"not found\n",
                            "text/plain; charset=utf-8")
         except BrokenPipeError:          # scraper went away mid-write
+            pass
+
+    def _refresh(self, skip_obs: bool = False) -> None:
+        """Scrape-time gauge refresh: the ledger-health gauges plus
+        the host's `pre_scrape` hook (serve uses it to publish
+        per-kind efficiency and SLO burn-rate). Never 500s a scrape."""
+        try:
+            if not skip_obs:
+                refresh_obs_gauges()
+            hook = getattr(self.server, "pre_scrape_fn", None)
+            if hook is not None:
+                hook()
+        except Exception:
             pass
 
     def log_message(self, *a):           # keep worker stdout clean
@@ -240,14 +286,18 @@ class MetricsServer:
 
     `varz` is an optional zero-arg callable returning a JSON-ready dict
     merged into /varz under "service" (and consulted for a "healthy"
-    key by /healthz)."""
+    key by /healthz). `pre_scrape` is an optional zero-arg callable
+    run before each /metrics or /varz render so the host can refresh
+    gauges that are only worth computing at scrape time (serve's
+    per-kind efficiency and SLO burn-rate)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 varz=None):
+                 varz=None, pre_scrape=None):
         self._httpd = http.server.ThreadingHTTPServer(
             (host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.varz_fn = varz
+        self._httpd.pre_scrape_fn = pre_scrape
         self.host, self.port = self._httpd.server_address[:2]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="obs-httpd",
@@ -265,7 +315,8 @@ class MetricsServer:
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1",
-                  varz=None) -> MetricsServer:
+                  varz=None, pre_scrape=None) -> MetricsServer:
     """Start the endpoint; returns the running server (port 0 = pick a
     free port; read `.port`/`.url`)."""
-    return MetricsServer(port=port, host=host, varz=varz)
+    return MetricsServer(port=port, host=host, varz=varz,
+                         pre_scrape=pre_scrape)

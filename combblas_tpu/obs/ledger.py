@@ -308,10 +308,12 @@ def instrument(fn, name: str, *, kind: str = "dispatch",
 
 
 def top_k(k: int = 10, by: str = "wall", ledger: Ledger | None = None,
-          records=None) -> list[dict]:
+          records=None, join_costs: bool = True) -> list[dict]:
     """Top-K executables by total wall (`by="wall"`) or call count
     (`by="count"`). Each row: name, count, total_s, mean_s, compiles,
-    arg_bytes, out_bytes."""
+    arg_bytes, out_bytes — plus the cost-model join (annotated, flops,
+    gflops_s, gbytes_s, bound, eff; None when the name carries no
+    annotation) unless `join_costs=False`."""
     recs = (ledger if ledger is not None else LEDGER).snapshot() \
         if records is None else records
     agg: dict = {}
@@ -332,22 +334,34 @@ def top_k(k: int = 10, by: str = "wall", ledger: Ledger | None = None,
     for row in rows:
         row["total_s"] = round(row["total_s"], 6)
         row["mean_s"] = round(row["total_s"] / row["count"], 6)
+    if join_costs:
+        from combblas_tpu.obs import costmodel
+        costmodel.join_rows(rows)
     return rows
 
 
 def format_table(k: int = 10, by: str = "wall",
                  ledger: Ledger | None = None) -> str:
-    """Human-readable top-K table (the `--gate`/README surface)."""
+    """Human-readable top-K table (the `--gate`/README surface). The
+    `eff` column is the roofline-efficiency fraction from the cost
+    model, with the bound class (c/m/i); blank when the name carries
+    no annotation."""
     rows = top_k(k, by=by, ledger=ledger)
     led = ledger if ledger is not None else LEDGER
     out = [f"dispatch ledger: {led.total} records "
            f"({led.dropped} wrapped out), top {len(rows)} by {by}:"]
     out.append(f"  {'executable':40s} {'count':>7s} {'total_s':>10s} "
-               f"{'mean_ms':>9s} {'compiles':>8s}")
+               f"{'mean_ms':>9s} {'compiles':>8s} {'eff':>8s}")
     for r in rows:
+        if r.get("eff") is not None:
+            eff = f"{r['eff']:.3f}/{r['bound'][0]}"
+        elif r.get("annotated"):
+            eff = "ann"        # annotated but zero-wall (plan records)
+        else:
+            eff = ""
         out.append(f"  {r['name'][:40]:40s} {r['count']:7d} "
                    f"{r['total_s']:10.4f} {r['mean_s'] * 1e3:9.3f} "
-                   f"{r['compiles']:8d}")
+                   f"{r['compiles']:8d} {eff:>8s}")
     return "\n".join(out)
 
 

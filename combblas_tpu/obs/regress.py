@@ -1,0 +1,445 @@
+"""Continuous-benchmark trajectory: normalize every committed bench
+artifact into ONE canonical, schema-validated series and detect
+regressions against it.
+
+The repo accumulates heterogeneous bench artifacts (`BENCH_r*.json`
+Graph500 runs, `MCL_BENCH_*.json`, `MULTICHIP_*.json`,
+`SERVE_BENCH.json`, `BITS_BENCH.json`, `ESC_MICROBENCH.json`) whose
+shapes drifted across PRs — pre-PR-6 artifacts carry no
+`dispatch_summary` at all, serve/multichip artifacts carry summaries
+but no span residual. This module is the single place that knows all
+of those shapes:
+
+* `normalize_artifact(name, doc)` — one canonical run row per
+  artifact (run id, workload, scale, backend, wall, headline value,
+  dispatch/compile counts, exchanged bytes, efficiency) with an
+  explicit `schema` grade: "full" (dispatch_summary AND
+  unaccounted_s), "partial" (summary only), "legacy" (pre-PR-6 —
+  flagged, never crashed on);
+* `build_trajectory(root)` — the committed `BENCH_TRAJECTORY.json`
+  (`scripts/bench_registry.py` is the CLI);
+* `validate_run(run)` / `validate_artifact(doc)` — the schema gate:
+  fresh artifacts missing `dispatch_summary` or `unaccounted_s` are
+  REJECTED (SchemaError) unless explicitly allowed as partial;
+* `compare(fresh, trajectory, bands)` — per-metric noise-banded
+  regression verdicts (direction-aware: GTEPS up is good, wall down
+  is good), consumed by `analysis.perfgate` (pass 5) and
+  `bench_registry.py --check`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import re
+
+SCHEMA_VERSION = "bench-trajectory/v1"
+
+#: glob -> workload. Order matters: first match wins.
+ARTIFACT_GLOBS = (
+    ("BENCH_r*.json", "bfs"),
+    ("MCL_BENCH_*.json", "mcl"),
+    ("MULTICHIP_*.json", "multichip"),
+    ("SERVE_BENCH*.json", "serve"),
+    ("BITS_BENCH*.json", "bits"),
+    ("ESC_MICROBENCH*.json", "esc"),
+)
+
+#: canonical run-row fields (None allowed unless listed in _REQUIRED)
+RUN_FIELDS = ("run_id", "artifact", "workload", "seq", "scale",
+              "backend", "wall_s", "value", "unit", "dispatches",
+              "compiles", "exchanged_bytes", "efficiency",
+              "attributable_frac", "unaccounted_s", "schema")
+
+_REQUIRED = ("run_id", "artifact", "workload", "schema")
+
+_SCHEMAS = ("full", "partial", "legacy")
+
+
+class SchemaError(ValueError):
+    """A bench artifact or trajectory violates the canonical schema."""
+
+
+# ---------------------------------------------------------------------------
+# artifact-shape helpers
+# ---------------------------------------------------------------------------
+
+def _collect_summaries(doc):
+    """Every dispatch_summary block in the document, wherever nested
+    (SERVE_BENCH keeps them under closed_loop/open_loop, BITS_BENCH
+    under serve_dense/serve_bits, MCL/ESC/MULTICHIP at top level)."""
+    out = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            ds = node.get("dispatch_summary")
+            if isinstance(ds, dict):
+                out.append(ds)
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(doc)
+    return out
+
+
+def _find_key(doc, key):
+    """First value for `key` anywhere in the document (depth-first)."""
+    if isinstance(doc, dict):
+        if key in doc:
+            return doc[key]
+        for v in doc.values():
+            got = _find_key(v, key)
+            if got is not None:
+                return got
+    elif isinstance(doc, list):
+        for v in doc:
+            got = _find_key(v, key)
+            if got is not None:
+                return got
+    return None
+
+
+def _num(v):
+    try:
+        f = float(v)
+        return f if math.isfinite(f) else None
+    except (TypeError, ValueError):
+        return None
+
+
+def _seq_of(name: str):
+    m = re.search(r"_r(\d+)\.json$", name)
+    return int(m.group(1)) if m else None
+
+
+def _scale_of(doc, name: str):
+    sc = _num(_find_key(doc, "scale"))
+    if sc is not None:
+        return int(sc)
+    # graph500 headline metrics encode it: ..._scale22_ef16_...
+    metric = _find_key(doc, "metric") or ""
+    m = re.search(r"scale(\d+)", str(metric))
+    if m:
+        return int(m.group(1))
+    n = _num(_find_key(doc, "n"))
+    if n and n > 1:
+        return int(round(math.log2(n)))
+    return None
+
+
+def _backend_of(doc):
+    plat = _find_key(doc, "platform")
+    if isinstance(plat, str) and plat:
+        return plat
+    tail = doc.get("tail") if isinstance(doc, dict) else None
+    if isinstance(tail, str):
+        m = re.search(r'"platform"\s*:\s*"(\w+)"', tail)
+        if m:
+            return m.group(1)
+    return None
+
+
+def _exchange_bytes(doc, summaries):
+    """Collective bytes on the wire: arg_bytes of the exchange-named
+    ledger rows in any summary, plus the explicit hybrid-exchange
+    accounting MULTICHIP artifacts carry."""
+    total = 0
+    seen = False
+    for s in summaries:
+        for row in s.get("top", ()):
+            name = row.get("name", "")
+            if name.startswith("spgemm.bcast") or \
+                    name.startswith("spmv.fan"):
+                total += int(row.get("arg_bytes", 0) or 0)
+                seen = True
+    hyb = _num(_find_key(doc, "hybrid_bytes"))
+    if hyb is not None:
+        total += int(hyb)
+        seen = True
+    return total if seen else None
+
+
+def _efficiency_of(summaries):
+    """(roofline eff, attributable fraction) — wall-weighted over the
+    `efficiency` blocks `export.dispatch_summary` embeds (PR 10+
+    artifacts only)."""
+    effs = []
+    fracs = []
+    for s in summaries:
+        blk = s.get("efficiency")
+        if isinstance(blk, dict):
+            if blk.get("eff") is not None:
+                effs.append(float(blk["eff"]))
+            if blk.get("attributable_frac") is not None:
+                fracs.append(float(blk["attributable_frac"]))
+    eff = round(sum(effs) / len(effs), 4) if effs else None
+    frac = round(sum(fracs) / len(fracs), 4) if fracs else None
+    return eff, frac
+
+
+def _wall_of(doc, workload):
+    w = _num(doc.get("wall_s")) if isinstance(doc, dict) else None
+    if w is not None:
+        return w
+    if workload == "serve":
+        cl = doc.get("closed_loop") or {}
+        return _num(cl.get("wall_s"))
+    if workload == "bits":
+        sb = doc.get("serve_bits") or {}
+        return _num(sb.get("wall_s"))
+    if workload == "multichip":
+        sp = doc.get("spgemm") or {}
+        return _num(sp.get("wall_auto_s"))
+    if workload == "mcl":
+        u = doc.get("unit")
+        if u in ("s", "seconds"):
+            return _num(doc.get("value"))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+def workload_of(name: str):
+    p = pathlib.PurePath(name).name
+    for pat, wl in ARTIFACT_GLOBS:
+        if pathlib.PurePath(p).match(pat):
+            return wl
+    return None
+
+
+def classify(doc) -> tuple:
+    """(schema grade, missing keys) for an artifact document."""
+    summaries = _collect_summaries(doc)
+    has_ds = bool(summaries)
+    has_un = _find_key(doc, "unaccounted_s") is not None
+    if has_ds and has_un:
+        return "full", []
+    if has_ds:
+        return "partial", ["unaccounted_s"]
+    missing = ["dispatch_summary"] + ([] if has_un else ["unaccounted_s"])
+    return "legacy", missing
+
+
+def validate_artifact(doc, name: str = "<artifact>",
+                      allow_partial: bool = False) -> str:
+    """Schema gate for FRESH artifacts: anything missing
+    `dispatch_summary` or `unaccounted_s` is rejected. Committed
+    pre-PR-6 artifacts are never validated through here — they are
+    flagged `schema: legacy` by `normalize_artifact` instead of
+    crashing the build."""
+    schema, missing = classify(doc)
+    if schema == "full":
+        return schema
+    if schema == "partial" and allow_partial:
+        return schema
+    raise SchemaError(
+        f"{name}: bench artifact missing {'/'.join(missing)} — "
+        f"re-run the bench with obs enabled (schema grade: {schema})")
+
+
+def normalize_artifact(name: str, doc) -> dict:
+    """One canonical run row for a committed artifact. Never raises on
+    shape drift: unparseable fields become None and the row carries
+    its `schema` grade."""
+    wl = workload_of(name)
+    if wl is None:
+        raise SchemaError(f"{name}: not a recognized bench artifact")
+    if not isinstance(doc, dict):
+        raise SchemaError(f"{name}: artifact root must be an object")
+    summaries = _collect_summaries(doc)
+    schema, _missing = classify(doc)
+
+    # headline value: graph500 runner artifacts wrap it in `parsed`
+    # (None when the run's tail was truncated — BENCH_r04)
+    src = doc
+    parsed = doc.get("parsed")
+    if wl == "bfs" and isinstance(parsed, dict):
+        src = parsed
+    value = _num(src.get("value"))
+    unit = src.get("unit") if isinstance(src.get("unit"), str) else None
+    if wl == "bits" and value is None:
+        value = _num(doc.get("per_root_speedup"))
+        unit = unit or "x_per_root"
+
+    dispatches = sum(int(s.get("dispatches", 0) or 0)
+                     for s in summaries) if summaries else None
+    compiles = sum(int(s.get("compiles", 0) or 0)
+                   for s in summaries) if summaries else None
+    eff, frac = _efficiency_of(summaries)
+    stem = pathlib.PurePath(name).name[:-len(".json")] \
+        if name.endswith(".json") else pathlib.PurePath(name).name
+    row = {
+        "run_id": stem,
+        "artifact": pathlib.PurePath(name).name,
+        "workload": wl,
+        "seq": _seq_of(pathlib.PurePath(name).name),
+        "scale": _scale_of(doc, name),
+        "backend": _backend_of(doc),
+        "wall_s": _wall_of(doc, wl),
+        "value": value,
+        "unit": unit,
+        "dispatches": dispatches,
+        "compiles": compiles,
+        "exchanged_bytes": _exchange_bytes(doc, summaries),
+        "efficiency": eff,
+        "attributable_frac": frac,
+        "unaccounted_s": _num(_find_key(doc, "unaccounted_s")),
+        "schema": schema,
+    }
+    validate_run(row)
+    return row
+
+
+def validate_run(run: dict) -> None:
+    """Canonical-row validation: required keys present, schema grade
+    known, numerics numeric. Raises SchemaError."""
+    if not isinstance(run, dict):
+        raise SchemaError("run row must be an object")
+    for k in _REQUIRED:
+        if not run.get(k):
+            raise SchemaError(f"run row missing required field {k!r}")
+    if run["schema"] not in _SCHEMAS:
+        raise SchemaError(f"{run['run_id']}: unknown schema grade "
+                          f"{run['schema']!r}")
+    unknown = set(run) - set(RUN_FIELDS)
+    if unknown:
+        raise SchemaError(f"{run['run_id']}: unknown fields "
+                          f"{sorted(unknown)}")
+    for k in ("wall_s", "value", "efficiency", "attributable_frac",
+              "unaccounted_s"):
+        v = run.get(k)
+        if v is not None and _num(v) is None:
+            raise SchemaError(f"{run['run_id']}: field {k} not numeric: "
+                              f"{v!r}")
+
+
+def build_trajectory(root, generated_by: str = "bench_registry") -> dict:
+    """Normalize every committed artifact under `root` into the
+    canonical trajectory document. Deterministic order: (workload,
+    seq, run_id)."""
+    root = pathlib.Path(root)
+    runs = []
+    seen = set()
+    for pat, _wl in ARTIFACT_GLOBS:
+        for p in sorted(root.glob(pat)):
+            if p.name in seen:
+                continue
+            seen.add(p.name)
+            try:
+                doc = json.loads(p.read_text())
+            except (OSError, ValueError) as e:
+                raise SchemaError(f"{p.name}: unreadable artifact: {e}")
+            runs.append(normalize_artifact(p.name, doc))
+    runs.sort(key=lambda r: (r["workload"], r["seq"] or 0, r["run_id"]))
+    return {"schema": SCHEMA_VERSION, "generated_by": generated_by,
+            "runs": runs}
+
+
+def load_trajectory(path) -> dict:
+    path = pathlib.Path(path)
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError) as e:
+        raise SchemaError(f"{path.name}: unreadable trajectory: {e}")
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA_VERSION:
+        raise SchemaError(f"{path.name}: expected schema "
+                          f"{SCHEMA_VERSION!r}, got "
+                          f"{doc.get('schema') if isinstance(doc, dict) else type(doc).__name__!r}")
+    for run in doc.get("runs", ()):
+        validate_run(run)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# regression detection
+# ---------------------------------------------------------------------------
+
+#: default per-metric noise bands when the budget file doesn't narrow
+#: them: fractional tolerance around the direction-aware baseline.
+DEFAULT_BANDS = (
+    {"workload": "*", "metric": "value", "direction": "higher",
+     "band_frac": 0.25},
+)
+
+
+def _band_applies(band, run):
+    wl = band.get("workload", "*")
+    return wl in ("*", run.get("workload"))
+
+
+def _baseline(runs, metric, direction):
+    """Direction-aware best over prior runs (ignoring Nones)."""
+    vals = [r.get(metric) for r in runs if r.get(metric) is not None]
+    if not vals:
+        return None
+    return max(vals) if direction == "higher" else min(vals)
+
+
+def compare(fresh: dict, trajectory: dict, bands=None) -> list:
+    """Noise-banded regression verdicts for one fresh canonical run
+    against the committed trajectory. Returns violation dicts:
+    {workload, metric, direction, band_frac, baseline, fresh, message}.
+
+    Baseline = direction-aware best among trajectory runs of the same
+    workload (restricted to the fresh run's scale when prior runs at
+    that scale exist — cross-scale numbers are not comparable). A
+    `higher` metric regresses when fresh < baseline*(1-band); `lower`
+    when fresh > baseline*(1+band)."""
+    validate_run(fresh)
+    bands = list(bands) if bands is not None else list(DEFAULT_BANDS)
+    pool = [r for r in trajectory.get("runs", ())
+            if r.get("workload") == fresh.get("workload")
+            and r.get("run_id") != fresh.get("run_id")]
+    same_scale = [r for r in pool
+                  if fresh.get("scale") is not None
+                  and r.get("scale") == fresh.get("scale")]
+    if same_scale:
+        pool = same_scale
+    out = []
+    for band in bands:
+        if not _band_applies(band, fresh):
+            continue
+        metric = band.get("metric", "value")
+        direction = band.get("direction", "higher")
+        frac = float(band.get("band_frac", 0.25))
+        fv = fresh.get(metric)
+        if fv is None:
+            continue
+        base = _baseline(pool, metric, direction)
+        if base is None:
+            continue
+        if direction == "higher":
+            bad = fv < base * (1.0 - frac)
+        else:
+            bad = fv > base * (1.0 + frac)
+        if bad:
+            out.append({
+                "workload": fresh.get("workload"),
+                "metric": metric,
+                "direction": direction,
+                "band_frac": frac,
+                "baseline": base,
+                "fresh": fv,
+                "message": (
+                    f"{fresh['run_id']}: {metric}={fv:g} regressed "
+                    f"past the {frac:.0%} noise band around "
+                    f"baseline {base:g} ({direction} is better)"),
+            })
+    return out
+
+
+def newest_runs(trajectory: dict) -> dict:
+    """workload -> highest-seq run (runs without a seq count as 0)."""
+    out: dict = {}
+    for r in trajectory.get("runs", ()):
+        wl = r["workload"]
+        cur = out.get(wl)
+        if cur is None or (r.get("seq") or 0) >= (cur.get("seq") or 0):
+            out[wl] = r
+    return out

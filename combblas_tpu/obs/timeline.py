@@ -201,8 +201,14 @@ def split_unaccounted(tracer=None, ledger=None) -> dict:
         if r.category is not None:
             continue
         kids = by_parent.get((r.tid, r.path), ())
-        holes = [(k.t0, k.t1) for k in kids
-                 if k.t0 >= r.t0 and k.t1 <= r.t1 + 1e-9]
+        # symmetric timer-jitter tolerance on BOTH edges (a child whose
+        # t0 lands 1ns before its parent's is still a child — the old
+        # asymmetric filter dropped it and double-counted its wall as
+        # parent self time), then clip to the parent window so the
+        # tolerated overhang can't subtract wall outside it.
+        holes = [(max(k.t0, r.t0), min(k.t1, r.t1)) for k in kids
+                 if k.t0 >= r.t0 - 1e-9 and k.t1 <= r.t1 + 1e-9
+                 and min(k.t1, r.t1) > max(k.t0, r.t0)]
         self_ivs = _subtract([(r.t0, r.t1)], holes)
         covered = 0.0
         for a, b in self_ivs:

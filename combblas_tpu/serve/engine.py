@@ -55,7 +55,7 @@ from combblas_tpu.ops.semiring import PLUS_TIMES_F32, Semiring
 from combblas_tpu.parallel import densemat as dmm
 from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
 from combblas_tpu.serve.batcher import Batch, DynamicBatcher, bucket_for
-from combblas_tpu.serve.plans import PlanCache, PlanKey
+from combblas_tpu.serve.plans import PlanCache, PlanKey, _plan_name
 from combblas_tpu.serve.queue import (
     DeadlineExceededError, QueueFullError, Request, RequestQueue,
     ResultHandle, ServiceStoppedError,
@@ -77,6 +77,14 @@ _dispatches = obs.counter("serve.dispatches",
 _shed = obs.counter("serve.shed", "requests shed, by reason")
 _queue_hw = obs.gauge("serve.queue_high_water",
                       "deepest the request queue has ever been")
+_slo_burn = obs.gauge(
+    "serve.slo_burn_rate",
+    "error-budget burn rate by kind: (bad_frac)/(1-slo_target); "
+    "1.0 = burning exactly at the sustainable rate")
+_efficiency = obs.gauge(
+    "serve.efficiency",
+    "wall-weighted roofline efficiency of this kind's dispatches "
+    "(obs.costmodel join over serve.* ledger names)")
 
 
 @dataclasses.dataclass
@@ -123,6 +131,11 @@ class GraphService:
                       "dispatches": 0, "warmup_dispatches": 0,
                       "shed": 0, "partials": 0, "rejected": 0}
         self._stats_lock = threading.Lock()
+        # per-kind SLO ledger: kind -> {"good": n, "bad": n}. A request
+        # is good when it completes within cfg.slo_latency_s of
+        # enqueue; shed/stopped requests are bad (they burned budget).
+        self._slo: dict = {}
+        self._nnz_cache: Optional[int] = None   # host nnz, synced once
         self._mesh = (a.grid.pr, a.grid.pc)
         self._bfs_level_est = self.cfg.bfs_level_est_s
         # per-kind EWMA dispatch-cost estimates (shed-before-dispatch
@@ -183,7 +196,8 @@ class GraphService:
         `stop()`."""
         if self._metrics_server is None:
             self._metrics_server = obs.serve_metrics(
-                port=port, host=host, varz=self._varz)
+                port=port, host=host, varz=self._varz,
+                pre_scrape=self._refresh_serve_gauges)
         return self._metrics_server
 
     def _varz(self) -> dict:
@@ -218,7 +232,63 @@ class GraphService:
                     r: _bfs._M_BITS_FALLBACK.value(kind=r)
                     for r in _bfs.BITS_FALLBACK_REASONS},
             },
+            # SLO verdict + per-kind roofline efficiency: the same
+            # numbers the `serve.slo_burn_rate{kind}` /
+            # `serve.efficiency{kind}` gauges publish on /metrics
+            "slo": {
+                "latency_s": self.cfg.slo_latency_s,
+                "target": self.cfg.slo_target,
+                "kinds": self._slo_snapshot(),
+            },
+            "efficiency": obs.costmodel.efficiency_by(self._serve_kind),
         }
+
+    # ------------------------------------------------------------------
+    # SLO accounting + per-kind roofline gauges
+    # ------------------------------------------------------------------
+
+    def _slo_count(self, kind: str, good: bool) -> None:
+        base = kind.split(":", 1)[0]     # spmv:<sr> pools under "spmv"
+        with self._stats_lock:
+            row = self._slo.setdefault(base, {"good": 0, "bad": 0})
+            row["good" if good else "bad"] += 1
+
+    def _slo_snapshot(self) -> dict:
+        """kind -> {good, bad, bad_frac, burn_rate}. Burn rate is
+        bad_frac/(1-slo_target): 1.0 burns the error budget exactly at
+        the sustainable rate, >1 exhausts it early."""
+        with self._stats_lock:
+            slo = {k: dict(v) for k, v in self._slo.items()}
+        denom = max(1.0 - self.cfg.slo_target, 1e-9)
+        out = {}
+        for kind, row in sorted(slo.items()):
+            total = row["good"] + row["bad"]
+            bad_frac = row["bad"] / total if total else 0.0
+            out[kind] = {"good": row["good"], "bad": row["bad"],
+                         "bad_frac": round(bad_frac, 6),
+                         "burn_rate": round(bad_frac / denom, 4)}
+        return out
+
+    @staticmethod
+    def _serve_kind(name: str) -> Optional[str]:
+        """Ledger-name -> request-kind grouping for the efficiency
+        gauges: "serve.bfs.bits/w32.l32" -> "bfs", "serve.cc/w8" ->
+        "cc", "serve.spmv.plus_times_f32/w8" -> "spmv"; non-serve
+        names -> None (excluded from the per-kind split)."""
+        if not name.startswith("serve."):
+            return None
+        return name[len("serve."):].split(".", 1)[0].split("/", 1)[0]
+
+    def _refresh_serve_gauges(self) -> None:
+        """Pre-scrape hook (obs.httpd calls it right before rendering
+        /metrics and /varz): publish the per-kind SLO burn-rate and
+        roofline-efficiency gauges from current state — gauges stay
+        fresh without any work on the dispatch path."""
+        for kind, row in self._slo_snapshot().items():
+            _slo_burn.set(row["burn_rate"], kind=kind)
+        for kind, eff in obs.costmodel.efficiency_by(
+                self._serve_kind).items():
+            _efficiency.set(eff, kind=kind)
 
     def _fail_pending(self) -> None:
         for r in self.queue.drain():
@@ -397,8 +467,9 @@ class GraphService:
 
     def _finish(self, req: Request, value) -> None:
         req.handle.set_result(value)
-        _latency.observe(time.monotonic() - req.enqueued_at,
-                         kind=req.kind)
+        lat = time.monotonic() - req.enqueued_at
+        _latency.observe(lat, kind=req.kind)
+        self._slo_count(req.kind, lat <= self.cfg.slo_latency_s)
         with self._stats_lock:
             self.stats["results"] += 1
 
@@ -406,6 +477,7 @@ class GraphService:
         with self._stats_lock:
             self.stats["shed"] += 1
         _shed.inc(kind=req.kind, reason=reason)
+        self._slo_count(req.kind, False)   # shed = error budget burned
 
     def _note_rejected(self, req: Request, reason: str) -> None:
         """Admission-time refusals (queue_full backpressure, dead on
@@ -422,6 +494,47 @@ class GraphService:
             self.stats["warmup_dispatches" if warmup
                        else "dispatches"] += 1
         _dispatches.inc(kind=kind, warmup=int(warmup))
+
+    # ------------------------------------------------------------------
+    # plan-time roofline annotations
+    # ------------------------------------------------------------------
+
+    def _host_nnz(self) -> int:
+        """Matrix nnz on the host, synced at most once per service
+        lifetime (plan builds are the only callers — the dispatch path
+        never pays the readback)."""
+        if self._nnz_cache is None:
+            self._nnz_cache = int(self.a.getnnz())
+        return self._nnz_cache
+
+    def _annotate_plan(self, name: str, kind: str, width: int) -> None:
+        """Register the expected per-dispatch cost of one serve plan
+        under its ledger name (obs.costmodel conventions: 2 flops per
+        semiring multiply-add, 12-byte COO slot). Called once per
+        plan build; the cost-model join multiplies by the ledger's
+        observed call count."""
+        cm = obs.costmodel
+        nnz, nrows = self._host_nnz(), int(self.a.nrows)
+        on_mesh = self._mesh != (1, 1)
+        if kind == "bfs":
+            # one batched traversal touches each stored edge ~once;
+            # frontier state is 8 B/vertex/root dense, ~1 bit packed
+            words = -(-width // _LANE_W)
+            packed = ".bits/" in name or name.endswith(f".l{_LANE_W}")
+            fstate = 4.0 * nrows * words if packed else 8.0 * nrows * width
+            cm.annotate(name, flops=2.0 * nnz,
+                        lbytes=12.0 * nnz + fstate,
+                        cbytes=fstate if on_mesh else 0.0)
+        elif kind == "cc":
+            # label gather: w index reads + w label writes
+            cm.annotate(name, lbytes=8.0 * width)
+        elif kind == "spmv":
+            # dense-panel SpMM: every slot read once, one x gather and
+            # one y update per (slot, column)
+            cm.annotate(name, flops=2.0 * nnz * width,
+                        lbytes=(12.0 + 8.0 * width) * nnz
+                        + 8.0 * nrows * width,
+                        cbytes=4.0 * nrows * width if on_mesh else 0.0)
 
     # ------------------------------------------------------------------
     # executors (one device dispatch per batch)
@@ -501,13 +614,19 @@ class GraphService:
         if bits is not None:
             eb = -(-bucket // _LANE_W) * _LANE_W
             key = PlanKey("bfs", "bits", eb, self._mesh, _LANE_W)
-            return eb, self.plans.get_or_build(
-                key, lambda: lambda roots, ml: _bfs.bfs_batch_bits(
-                    self.a, roots, ml, plan=bits))
+
+            def build_bits():
+                self._annotate_plan(_plan_name(key), "bfs", eb)
+                return lambda roots, ml: _bfs.bfs_batch_bits(
+                    self.a, roots, ml, plan=bits)
+            return eb, self.plans.get_or_build(key, build_bits)
         key = PlanKey("bfs", "select2nd_max_i32", bucket, self._mesh)
-        return bucket, self.plans.get_or_build(
-            key, lambda: lambda roots, ml: _bfs.bfs_batch(
-                self.a, roots, ml, plan=base))
+
+        def build_dense():
+            self._annotate_plan(_plan_name(key), "bfs", bucket)
+            return lambda roots, ml: _bfs.bfs_batch(
+                self.a, roots, ml, plan=base)
+        return bucket, self.plans.get_or_build(key, build_dense)
 
     def _run_bfs(self, batch: Batch) -> None:
         reqs = batch.requests
@@ -570,14 +689,20 @@ class GraphService:
         self._cost_est[kind] = (wall if old is None
                                 else 0.7 * old + 0.3 * wall)
 
+    def _cc_plan(self, bucket: int):
+        key = PlanKey("cc", "-", bucket, self._mesh)
+
+        def build():
+            self._annotate_plan(_plan_name(key), "cc", bucket)
+            return jax.jit(lambda lab, ix: lab[ix])
+        return self.plans.get_or_build(key, build)
+
     def _run_cc(self, batch: Batch) -> None:
         reqs = batch.requests
         labels = self._labels_device()
         verts = np.array([r.payload for r in reqs], np.int32)
         verts_p = self._pad(verts, batch.bucket)
-        key = PlanKey("cc", "-", batch.bucket, self._mesh)
-        fn = self.plans.get_or_build(
-            key, lambda: jax.jit(lambda lab, ix: lab[ix]))
+        fn = self._cc_plan(batch.bucket)
         t0 = time.monotonic()
         out = np.asarray(fn(labels, jnp.asarray(verts_p)))
         self._update_cost("cc", time.monotonic() - t0)
@@ -589,6 +714,7 @@ class GraphService:
         key = PlanKey("spmv", sr.name, bucket, self._mesh)
 
         def build():
+            self._annotate_plan(_plan_name(key), "spmv", bucket)
             grid, tn, glen = self.a.grid, self.a.tile_n, self.a.ncols
             nrows = self.a.nrows
             # square meshes take the tall-and-skinny schedule: the
@@ -654,9 +780,7 @@ class GraphService:
                     self._count_dispatch("bfs", warmup=True)
                 elif kind == "cc":
                     labels = self._labels_device()
-                    key = PlanKey("cc", "-", b, self._mesh)
-                    fn = self.plans.get_or_build(
-                        key, lambda: jax.jit(lambda lab, ix: lab[ix]))
+                    fn = self._cc_plan(b)
                     np.asarray(fn(labels, jnp.zeros((b,), jnp.int32)))
                     self._count_dispatch("cc", warmup=True)
                 elif isinstance(kind, Semiring):

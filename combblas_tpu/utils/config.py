@@ -12,12 +12,70 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 from typing import Optional, Type, TypeVar
 
 from combblas_tpu.models.mcl import MclParams
 
 T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# Roofline peak table (obs.costmodel's denominator)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class BackendPeaks:
+    """Per-backend roofline ceilings. These are deliberately coarse,
+    DOCUMENTED estimates — the cost model classifies dispatches as
+    compute-/memory-/ICI-bound and reports an efficiency FRACTION, so
+    only the ratios between the three ceilings need to be in the right
+    ballpark, not the absolute numbers."""
+
+    name: str
+    flops_per_s: float      # sustained f32 FLOP/s (MXU for TPU)
+    mem_bytes_per_s: float  # HBM / main-memory stream bandwidth
+    ici_bytes_per_s: float  # per-link interconnect bandwidth
+
+
+#: name -> peaks. "cpu" models the single-process XLA:CPU backend the
+#: tests/benches run on (a few vectorized cores); "tpu" models a
+#: v5e-class chip (f32 MXU ~49 TFLOP/s, 819 GB/s HBM, ~160 GB/s ICI
+#: per link). Unknown platforms fall back to "cpu".
+PEAKS = {
+    "cpu": BackendPeaks("cpu", 5.0e10, 2.0e10, 1.0e10),
+    "tpu": BackendPeaks("tpu", 4.9e13, 8.2e11, 1.6e11),
+}
+
+
+def backend_peaks(platform: Optional[str] = None) -> BackendPeaks:
+    """Resolve the roofline peak row for ``platform`` (default: jax's
+    default backend; the experimental relay platform counts as TPU).
+    COMBBLAS_TPU_PEAKS may carry a JSON object overriding any field,
+    e.g. '{"flops_per_s": 1e12}' — measured-machine calibration
+    without a code change."""
+    if platform is None:
+        try:
+            import jax
+            platform = jax.default_backend()
+        except Exception:
+            platform = "cpu"
+    platform = (platform or "cpu").lower()
+    if platform not in PEAKS:
+        platform = "tpu" if platform in ("axon", "tpu_relay") else "cpu"
+    base = PEAKS[platform]
+    raw = os.environ.get("COMBBLAS_TPU_PEAKS", "")
+    if raw:
+        try:
+            override = json.loads(raw)
+            base = dataclasses.replace(
+                base, **{k: float(v) for k, v in override.items()
+                         if k in ("flops_per_s", "mem_bytes_per_s",
+                                  "ici_bytes_per_s")})
+        except (ValueError, TypeError):
+            pass                    # malformed override: keep the table
+    return base
 
 
 def setup_compilation_cache(path: Optional[str] = None) -> Optional[str]:
@@ -90,6 +148,13 @@ class ServeConfig:
     # to streaming P² sketches (full-run p50/p90/p99 on unbounded
     # soaks); False keeps the sliding 2048-sample reservoir
     latency_sketch: bool = False
+    # SLO accounting: a request is "good" when it completes (not shed)
+    # within slo_latency_s of enqueue; slo_target is the good-fraction
+    # objective. burn rate = (bad_frac)/(1 - slo_target): 1.0 burns
+    # the error budget exactly at sustainable rate, >1 exhausts it
+    # (gauges `serve.slo_burn_rate{kind}` on /metrics and /varz)
+    slo_latency_s: float = 0.25
+    slo_target: float = 0.99
 
 
 def parse_cli(cls: Type[T], argv: Optional[list] = None,
@@ -116,4 +181,5 @@ def _resolve(t):
 
 
 __all__ = ["BfsConfig", "SpGemmBenchConfig", "ServeConfig", "MclParams",
+           "BackendPeaks", "backend_peaks",
            "parse_cli", "setup_compilation_cache"]

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Static-analysis gate: one command, three passes, one verdict.
+"""Static-analysis gate: one command, five passes, one verdict.
 
     PYTHONPATH=/root/repo python scripts/analyze.py --gate
 
-Passes (all trace/AST only — nothing compiles or runs device code):
+Passes (all trace/AST/JSON only — nothing compiles or runs device
+code):
 
   budgets   jaxpr/HLO budget engine over the registered kernel entry
             points vs the JSON budgets in combblas_tpu/analysis/budgets/
@@ -13,6 +14,10 @@ Passes (all trace/AST only — nothing compiles or runs device code):
   obs       obs-residual budgets over committed bench artifacts:
             unaccounted_s fractions, dispatch counts, ledger coverage
             (obs_residual.json)
+  perf      perf-regression gate over the committed bench trajectory:
+            BENCH_TRAJECTORY.json coverage, roofline-efficiency
+            floors, newest-vs-baseline noise bands
+            (perf_regression.json)
 
 Exit status: 0 iff no unsuppressed finding (the CI gate contract —
 `pytest -m quick` runs the same passes via tests/test_analysis.py).
@@ -23,7 +28,8 @@ Every finding prints as `file:line: [rule-id] message`; waive with
                   fixtures in tests/fixtures/analysis/ and verify each
                   rule actually FIRES (exit 0 = the gate bites)
     --json        machine-readable findings on stdout
-    --passes a,b  subset of budgets,retrace,locks (default: all)
+    --passes a,b  subset of budgets,retrace,locks,obs,perf (default:
+                  all)
     --entry NAME  restrict the budget pass to one registry entry
 """
 
@@ -74,6 +80,10 @@ def run_passes(passes, entry=None):
         t0 = time.time()
         findings += analysis.run_obs()
         timings["obs"] = time.time() - t0
+    if "perf" in passes and entry is None:
+        t0 = time.time()
+        findings += analysis.run_perf()
+        timings["perf"] = time.time() - t0
     return findings, timings
 
 
@@ -161,6 +171,29 @@ def self_test() -> int:
     else:
         print("  [ok] bad_obs_budget.json: missing artifact flagged")
 
+    print("fixture: bad_perf_budget.json")
+    from combblas_tpu.analysis import perfgate
+    fs = perfgate.run_perf(files=[fx / "bad_perf_budget.json"], root=fx)
+    expect("perf gate overshoot", {f.rule for f in fs},
+           core.PERF_EFFICIENCY, core.PERF_REGRESSION, core.PERF_STALE)
+    # both floor arms must fire (attributable_frac AND efficiency)
+    floors = [f for f in fs if f.rule == core.PERF_EFFICIENCY]
+    if len(floors) != 2:
+        failures.append(f"bad_perf_budget.json: expected 2 surviving "
+                        f"efficiency-floor findings (attributable_frac "
+                        f"+ efficiency), got {len(floors)}")
+    else:
+        print("  [ok] bad_perf_budget.json: both floor arms fire")
+    # resolved against the repo root the fixture trajectory is absent:
+    # the missing-trajectory arm of perf-stale-trajectory must fire
+    missing = perfgate.run_perf(files=[fx / "bad_perf_budget.json"])
+    if not any(f.rule == core.PERF_STALE and "not found" in f.message
+               for f in missing):
+        failures.append("bad_perf_budget.json: missing trajectory did "
+                        "not flag perf-stale-trajectory")
+    else:
+        print("  [ok] bad_perf_budget.json: missing trajectory flagged")
+
     for fname, rule in [("bad_lock_cycle.py", core.LOCK_CYCLE),
                         ("bad_jit_under_lock.py", core.JIT_UNDER_LOCK),
                         ("bad_bare_acquire.py", core.BARE_ACQUIRE)]:
@@ -196,8 +229,8 @@ def main() -> int:
                          "bad-pattern fixtures")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings")
-    ap.add_argument("--passes", default="budgets,retrace,locks,obs",
-                    help="comma list of budgets,retrace,locks,obs")
+    ap.add_argument("--passes", default="budgets,retrace,locks,obs,perf",
+                    help="comma list of budgets,retrace,locks,obs,perf")
     ap.add_argument("--entry", default=None,
                     help="restrict the budget pass to one entry point")
     args = ap.parse_args()
@@ -207,7 +240,7 @@ def main() -> int:
         return self_test()
 
     passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
-    bad = set(passes) - {"budgets", "retrace", "locks", "obs"}
+    bad = set(passes) - {"budgets", "retrace", "locks", "obs", "perf"}
     if bad:
         ap.error(f"unknown pass(es): {sorted(bad)}")
     findings, timings = run_passes(passes, entry=args.entry)

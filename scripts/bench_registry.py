@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Bench-trajectory registry: the committed-artifact half of the
+regression sentinel.
+
+    PYTHONPATH=/root/repo python scripts/bench_registry.py
+
+Default action normalizes every committed bench artifact
+(BENCH_r*.json, MCL_BENCH_*.json, MULTICHIP_*.json, SERVE_BENCH*.json,
+BITS_BENCH*.json, ESC_MICROBENCH*.json) into the canonical
+schema-validated trajectory and writes BENCH_TRAJECTORY.json at the
+repo root. Pre-PR-6 artifacts that predate the dispatch-summary
+protocol are flagged `schema: legacy` — never crashed on, never
+silently upgraded.
+
+    --verify            rebuild and diff against the committed
+                        trajectory instead of writing (exit 1 on
+                        drift — the "did you forget to regenerate"
+                        check; analysis pass 5 runs the same diff)
+    --check FRESH.json  validate ONE fresh artifact against the strict
+                        schema (dispatch_summary AND unaccounted_s
+                        required; --allow-partial waives the span
+                        residual) and run the banded regression
+                        comparison against the committed trajectory.
+                        Exit 1 on schema rejection or any violation.
+    --json              machine-readable output on stdout
+
+This script is pure JSON plumbing — it never imports jax and can run
+anywhere (CI formatters, pre-commit hooks).
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from combblas_tpu.obs import regress  # noqa: E402
+
+TRAJECTORY = REPO / "BENCH_TRAJECTORY.json"
+
+
+def _emit(doc, as_json):
+    if as_json:
+        print(json.dumps(doc, indent=1, sort_keys=True))
+
+
+def cmd_build(args) -> int:
+    traj = regress.build_trajectory(REPO)
+    text = json.dumps(traj, indent=1, sort_keys=True) + "\n"
+    if args.verify:
+        if not TRAJECTORY.exists():
+            print(f"FAIL: {TRAJECTORY.name} missing — run "
+                  "scripts/bench_registry.py to generate it")
+            return 1
+        committed = TRAJECTORY.read_text()
+        if committed != text:
+            try:
+                old = json.loads(committed)
+                old_ids = {r["run_id"] for r in old.get("runs", ())}
+            except ValueError:
+                old_ids = set()
+            new_ids = {r["run_id"] for r in traj["runs"]}
+            print(f"FAIL: {TRAJECTORY.name} is stale "
+                  f"(+{sorted(new_ids - old_ids)} "
+                  f"-{sorted(old_ids - new_ids)}); regenerate with "
+                  "scripts/bench_registry.py")
+            return 1
+        print(f"OK: {TRAJECTORY.name} matches {len(traj['runs'])} "
+              "committed artifacts")
+        _emit(traj, args.json)
+        return 0
+    TRAJECTORY.write_text(text)
+    legacy = sum(r["schema"] == "legacy" for r in traj["runs"])
+    partial = sum(r["schema"] == "partial" for r in traj["runs"])
+    print(f"wrote {TRAJECTORY.name}: {len(traj['runs'])} runs "
+          f"({legacy} legacy, {partial} partial)")
+    _emit(traj, args.json)
+    return 0
+
+
+def cmd_check(args) -> int:
+    p = pathlib.Path(args.check)
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, ValueError) as e:
+        print(f"FAIL: {p.name}: unreadable artifact: {e}")
+        return 1
+    try:
+        regress.validate_artifact(doc, p.name,
+                                  allow_partial=args.allow_partial)
+        run = regress.normalize_artifact(p.name, doc)
+    except regress.SchemaError as e:
+        print(f"FAIL: {e}")
+        return 1
+    try:
+        traj = regress.load_trajectory(TRAJECTORY)
+    except regress.SchemaError as e:
+        print(f"FAIL: no usable committed trajectory: {e}")
+        return 1
+    violations = regress.compare(run, traj)
+    _emit({"run": run, "violations": violations}, args.json)
+    for v in violations:
+        print(f"FAIL: [{v['workload']}/{v['metric']}] {v['message']}")
+    if violations:
+        return 1
+    print(f"OK: {run['run_id']} (schema {run['schema']}) within the "
+          "noise bands of the committed trajectory")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_registry",
+        description="build/verify BENCH_TRAJECTORY.json and "
+                    "regression-check fresh bench artifacts")
+    ap.add_argument("--verify", action="store_true",
+                    help="diff a rebuild against the committed "
+                         "trajectory instead of writing")
+    ap.add_argument("--check", metavar="FRESH.json",
+                    help="schema-validate one fresh artifact and "
+                         "compare it against the trajectory")
+    ap.add_argument("--allow-partial", action="store_true",
+                    help="--check: accept artifacts that carry "
+                         "dispatch_summary but no unaccounted_s")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    if args.check:
+        return cmd_check(args)
+    return cmd_build(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

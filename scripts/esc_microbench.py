@@ -287,6 +287,7 @@ def main():
                     "asserted across every variant per workload.",
         },
         "dispatch_summary": dispatches,
+        "roofline": dispatches.get("efficiency"),
         "note": "median wall time of the full jitted ESC SpGEMM "
                 "(expand + sort + dedup + re-sort) divided by flops_cap; "
                 "every variant runs the identical tile and flops_cap, "

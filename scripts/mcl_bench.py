@@ -179,6 +179,7 @@ def main():
         "spans": obs.export.report(),
         "metrics": obs.REGISTRY.snapshot(),
         "dispatch_summary": dispatches,
+        "roofline": dispatches.get("efficiency"),
         "dispatch": {
             **fused_stats,
             **({"sync_per_iteration": sync_stats["per_iteration"],

@@ -331,6 +331,7 @@ def main():
         "kinds": list(kinds),
         **{k: v for k, v in sections.items()},
         "dispatch_summary": summary,
+        "roofline": summary.get("efficiency"),
         "note": "dryrun: full correctness sweep on the virtual mesh. "
                 "spgemm: per-round exchanged bytes of the hybrid "
                 "sparse/dense SUMMA broadcast vs all-dense on a "

@@ -211,6 +211,7 @@ def main():
                     shed += 1
         wall = time.perf_counter() - t0
         svc.stop()
+        dispatches = obs.dispatch_summary()
         obs.set_enabled(False)
         served = nq - shed
         rec = {"mode": mode, "wall_s": round(wall, 4),
@@ -224,7 +225,8 @@ def main():
                "latency": percentiles(),
                "plan_cache": svc.plans.stats(),
                "rejected": svc.stats["rejected"],
-               "dispatch_summary": obs.dispatch_summary()}
+               "dispatch_summary": dispatches,
+               "roofline": dispatches.get("efficiency")}
         print(json.dumps(rec), flush=True)
         return rec
 
@@ -404,6 +406,7 @@ def run_bits(args):
             tot = sum(s["sum"] for s in occ["series"])
             cnt = sum(s["count"] for s in occ["series"])
             occ_mean = round(tot / cnt, 4) if cnt else None
+        dispatches = obs.dispatch_summary()
         rec = {"mode": f"serve_{name}", "wall_s": round(wall, 4),
                "qps": round(nq / wall, 2),
                "bfs_dispatches": bfs_disp,
@@ -411,7 +414,8 @@ def run_bits(args):
                "batch_occupancy_mean": occ_mean,
                "buckets": list(cfg.buckets),
                "plan_cache": svc.plans.stats(),
-               "dispatch_summary": obs.dispatch_summary()}
+               "dispatch_summary": dispatches,
+               "roofline": dispatches.get("efficiency")}
         svc.stop()
         obs.set_enabled(False)
         print(json.dumps(rec), flush=True)

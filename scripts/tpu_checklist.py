@@ -15,6 +15,13 @@ Steps (each standalone, continues past failures):
      /metrics + /varz + /healthz over real HTTP, and verify the
      dispatch ledger recorded the executables. Proves the recorder
      works against THIS backend before any long step runs blind.
+  0f. (--perf) perf-sentinel smoke: rebuild the bench trajectory and
+     diff it against the committed BENCH_TRAJECTORY.json, run one tiny
+     instrumented BFS into a full-schema artifact through the strict
+     validator + regression detector (a doctored 100x regression must
+     fire, a clone of the committed newest run must not), and scrape
+     the cost-model /varz + /metrics fields (costmodel.registry_size,
+     obs_ledger_dropped, obs_instrumented_registry_size).
   0c. (--mcl) fused-MCL smoke: two async mega-step iterations on a
      tiny planted two-clique graph; the ledger must show the fused
      `mcl.megastep` executable and ZERO blocking per-window nnz
@@ -122,6 +129,130 @@ def run_obs_check(grid) -> bool:
         obs.set_enabled(False)
         obs.reset()
         obs.ledger.LEDGER.reset()
+    return ok
+
+
+def run_perf_check(grid) -> bool:
+    """Step 0f: perf-sentinel smoke — rebuild the bench trajectory
+    against the committed one, push a tiny fresh instrumented run
+    through the strict artifact schema + the regression detector
+    (including a doctored run that MUST violate), and scrape the
+    cost-model /varz + /metrics fields the roofline join publishes."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu import obs
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.obs import regress
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm
+
+    step("0f. perf sentinel smoke (--perf)")
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    ok = True
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.costmodel.reset()
+    obs.set_enabled(True)
+    srv = obs.serve_metrics(port=0)
+    try:
+        # 1. the committed trajectory must match a rebuild
+        traj = regress.build_trajectory(repo)
+        committed = regress.load_trajectory(repo / "BENCH_TRAJECTORY.json")
+        if traj["runs"] != committed["runs"]:
+            print("FAIL: BENCH_TRAJECTORY.json is stale — regenerate "
+                  "with scripts/bench_registry.py")
+            ok = False
+        else:
+            print(f"trajectory: {len(traj['runs'])} run(s), matches "
+                  "rebuild")
+
+        # 2. tiny fresh run -> full-schema artifact -> canonical row
+        n = 1 << 8
+        r, c = generate.rmat_edges(jax.random.key(5), 8, 8)
+        a = dm.from_global_coo(S.LOR, grid, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        plan = B.plan_bfs(a)     # eager plan = cost-model registration
+        t0 = time.perf_counter()
+        B.bfs(a, 0, plan)
+        wall = time.perf_counter() - t0
+        fresh = {"scale": 8, "wall_s": wall,
+                 "value": 2.0 * int(r.shape[0]) / max(wall, 1e-9) / 1e9,
+                 "unit": "GTEPS", "platform": jax.default_backend(),
+                 "dispatch_summary": obs.dispatch_summary(),
+                 "unaccounted_s": 0.0}
+        grade = regress.validate_artifact(fresh, "BENCH_r98.json")
+        row = regress.normalize_artifact("BENCH_r98.json", fresh)
+        print(f"fresh artifact: schema {grade}, eff="
+              f"{row['efficiency']}, attributable="
+              f"{row['attributable_frac']}")
+        if grade != "full":
+            print("FAIL: fresh instrumented artifact did not grade "
+                  "'full'")
+            ok = False
+        if row["attributable_frac"] is None:
+            print("FAIL: cost-model join left attributable_frac null")
+            ok = False
+
+        # 3. the regression detector must bite on a doctored run and
+        #    stay quiet on a clone of the committed newest run
+        newest = regress.newest_runs(committed).get("bfs")
+        if newest is not None:
+            clone = dict(newest, run_id="BENCH_r98",
+                         artifact="BENCH_r98.json")
+            if regress.compare(clone, committed):
+                print("FAIL: regression detector fired on a clone of "
+                      "the committed newest run")
+                ok = False
+            doctored = dict(clone)
+            doctored["value"] = (newest["value"] or 1.0) * 0.01
+            if not regress.compare(doctored, committed):
+                print("FAIL: regression detector silent on a 100x "
+                      "GTEPS regression")
+                ok = False
+            else:
+                print("regression detector: quiet on clone, fires on "
+                      "100x regression")
+
+        # 4. the roofline join must be visible over real HTTP
+        with urllib.request.urlopen(srv.url + "/varz", timeout=10) as f:
+            varz = json.loads(f.read().decode())
+        cm = varz.get("costmodel") or {}
+        if not cm.get("registry_size"):
+            print("FAIL: /varz costmodel.registry_size empty")
+            ok = False
+        eff = (cm.get("efficiency") or {}).get("attributable_frac")
+        if eff is None:
+            print("FAIL: /varz costmodel.efficiency.attributable_frac "
+                  "missing")
+            ok = False
+        led = varz.get("ledger") or {}
+        if "dropped" not in led or "instrumented_count" not in led:
+            print("FAIL: /varz ledger lacks dropped/instrumented_count")
+            ok = False
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as f:
+            metrics = f.read().decode()
+        for gname in ("obs_ledger_dropped",
+                      "obs_costmodel_registry_size",
+                      "obs_instrumented_registry_size"):
+            if gname not in metrics:
+                print(f"FAIL: /metrics lacks {gname}")
+                ok = False
+        print(f"varz costmodel: registry_size={cm.get('registry_size')}"
+              f" attributable_frac={eff}")
+        print("perf sentinel:", "OK" if ok else "FAILED")
+    except Exception:
+        traceback.print_exc()
+        ok = False
+    finally:
+        srv.stop()
+        obs.set_enabled(False)
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+        obs.costmodel.reset()
     return ok
 
 
@@ -360,6 +491,12 @@ def main():
     ap.add_argument("--obs", action="store_true",
                     help="flight-recorder smoke: instrumented BFS, "
                          "live /metrics scrape, ledger non-empty")
+    ap.add_argument("--perf", action="store_true",
+                    help="perf-sentinel smoke: rebuild the bench "
+                         "trajectory vs the committed one, run a tiny "
+                         "fresh artifact through the strict schema + "
+                         "regression detector, scrape the cost-model "
+                         "/varz and /metrics fields")
     ap.add_argument("--mcl", action="store_true",
                     help="fused-MCL smoke: two async mega-step "
                          "iterations on a tiny planted graph; ledger "
@@ -394,6 +531,8 @@ def main():
     grid = ProcGrid.make(1, 1, jax.devices()[:1])
 
     if args.obs and not run_obs_check(grid):
+        sys.exit(1)
+    if args.perf and not run_perf_check(grid):
         sys.exit(1)
     if args.mcl and not run_mcl_check(grid):
         sys.exit(1)

@@ -1,4 +1,4 @@
-"""Static-analysis gate (combblas_tpu.analysis): the four passes run
+"""Static-analysis gate (combblas_tpu.analysis): the five passes run
 clean on the merged tree, each rule demonstrably FIRES on its
 committed bad-pattern fixture under tests/fixtures/analysis/, and the
 retrace signature model agrees with jax's actual compile behavior.
@@ -17,7 +17,7 @@ import pytest
 
 from combblas_tpu import analysis
 from combblas_tpu.analysis import (budget, core, entries, hlo, lockorder,
-                                   obsbudget, retrace)
+                                   obsbudget, perfgate, retrace)
 
 pytestmark = pytest.mark.quick
 
@@ -53,6 +53,14 @@ def test_obs_pass_clean_on_tree():
     artifacts (SERVE_BENCH/BITS_BENCH dispatch counts, instrumentation
     coverage, MCL unaccounted fraction)."""
     fs = obsbudget.run_obs()
+    assert not fs, _fmt(fs)
+
+
+def test_perf_pass_clean_on_tree():
+    """The committed BENCH_TRAJECTORY.json covers every committed
+    bench artifact and holds against the perf_regression.json bands
+    and efficiency floors."""
+    fs = perfgate.run_perf()
     assert not fs, _fmt(fs)
 
 
@@ -189,6 +197,35 @@ def test_obs_ledger_name_prefix_match():
     assert not obsbudget._name_covered("serve.bfs", {"serve"})
 
 
+def test_perf_fixture_fires_all_three_rules():
+    """The paired bad trajectory violates both efficiency-floor arms
+    (attributable_frac AND efficiency), regresses the newest bfs run
+    past its value band, and leaves the fixture's BENCH_r99.json
+    artifact uncovered — every pass-5 rule fires, anchored to the
+    budget file."""
+    fs = perfgate.run_perf(files=[FIXTURES / "bad_perf_budget.json"],
+                           root=FIXTURES)
+    rules = {f.rule for f in fs}
+    assert {core.PERF_EFFICIENCY, core.PERF_REGRESSION,
+            core.PERF_STALE} <= rules, _fmt(fs)
+    floors = [f for f in fs if f.rule == core.PERF_EFFICIENCY]
+    assert len(floors) == 2, _fmt(floors)
+    stale = [f for f in fs if f.rule == core.PERF_STALE]
+    assert any("BENCH_r99" in f.message for f in stale), _fmt(stale)
+    for f in fs:
+        assert f.file.endswith("bad_perf_budget.json")
+
+
+def test_perf_missing_trajectory_is_stale():
+    # resolved against the repo root (default), the fixture's
+    # trajectory file does not exist -> one stale finding, no crash
+    fs = perfgate.run_perf(files=[FIXTURES / "bad_perf_budget.json"])
+    assert any(f.rule == core.PERF_STALE and "not found" in f.message
+               for f in fs), _fmt(fs)
+    assert not any(f.rule in (core.PERF_EFFICIENCY,
+                              core.PERF_REGRESSION) for f in fs)
+
+
 def test_pr4_deadlock_shape_is_seen_and_deliberately_waived():
     """Regression guard for the PR-4 hang: the lint must still SEE the
     jit-dispatch-under-lock sites in serve/engine.py (the raw analyzer
@@ -257,7 +294,8 @@ def test_bits_ladder_folds_to_one_signature():
 # ---------------------------------------------------------------------------
 
 def test_run_all_selected_passes_clean():
-    assert analysis.run_all(passes=("retrace", "locks", "obs")) == []
+    assert analysis.run_all(passes=("retrace", "locks", "obs",
+                                    "perf")) == []
 
 
 def test_cli_gate_exit_codes():
@@ -267,7 +305,7 @@ def test_cli_gate_exit_codes():
     finds violations (driven via the self-test fixtures)."""
     r = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "analyze.py"),
-         "--gate", "--passes", "locks,retrace,obs"],
+         "--gate", "--passes", "locks,retrace,obs,perf"],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "PASS" in r.stdout

@@ -1,0 +1,271 @@
+"""Roofline cost-model registry (obs.costmodel): annotation
+accumulation math, the ledger join (achieved rates, bound class,
+efficiency), and the e2e attribution contract — a phased-SpGEMM run
+whose ledger wall is >= 90% explained by cost annotations."""
+
+import jax
+import numpy as np
+import pytest
+
+from combblas_tpu import obs
+from combblas_tpu.obs import costmodel, ledger
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import spgemm as SPG
+from combblas_tpu.parallel.grid import ProcGrid
+from combblas_tpu.utils.config import BackendPeaks
+
+#: deterministic peaks so rate assertions don't depend on the backend
+PEAKS = BackendPeaks(name="test", flops_per_s=1e9,
+                     mem_bytes_per_s=1e8, ici_bytes_per_s=1e7)
+
+
+@pytest.fixture
+def clean_registry():
+    costmodel.reset()
+    ledger.reset()
+    yield
+    costmodel.reset()
+    ledger.reset()
+
+
+@pytest.fixture
+def obs_on():
+    was = obs.enabled()
+    obs.set_enabled(True)
+    obs.reset()
+    yield
+    obs.set_enabled(was)
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry math
+# ---------------------------------------------------------------------------
+
+def test_annotate_accumulates_totals_and_calls(clean_registry):
+    costmodel.annotate("k", flops=100, lbytes=10, cbytes=1)
+    costmodel.annotate("k", flops=300, lbytes=30, cbytes=3)
+    c = costmodel.cost_for("k")
+    assert c == {"flops": 200.0, "lbytes": 20.0, "cbytes": 2.0,
+                 "calls": 2}
+    assert costmodel.registry_size() == 1
+    assert costmodel.cost_for("unknown") is None
+
+
+def test_annotate_calls_zero_credits_cost_without_denominator(
+        clean_registry):
+    # the plan_bcast trick: credit wire bytes to an already-registered
+    # name without inflating its per-call rate denominator
+    costmodel.annotate("k", flops=100, calls=1)
+    costmodel.annotate("k", cbytes=500, calls=0)
+    c = costmodel.cost_for("k")
+    assert c["calls"] == 1
+    assert c["flops"] == 100.0 and c["cbytes"] == 500.0
+    # a calls=0-only name still divides by max(calls, 1)
+    costmodel.annotate("plan_only", cbytes=64, calls=0)
+    assert costmodel.cost_for("plan_only")["cbytes"] == 64.0
+
+
+def test_snapshot_and_reset(clean_registry):
+    costmodel.annotate("a", flops=1)
+    costmodel.annotate("b", lbytes=2)
+    snap = costmodel.snapshot()
+    assert set(snap) == {"a", "b"}
+    assert snap["a"]["flops"] == 1.0 and snap["b"]["lbytes"] == 2.0
+    costmodel.reset()
+    assert costmodel.registry_size() == 0
+
+
+def test_roofline_time_bound_classification():
+    # flops-dominated: 1e9 flops at 1e9 flop/s = 1s vs tiny byte terms
+    t, bound = costmodel.roofline_time_s(1e9, 1e3, 1e3, peaks=PEAKS)
+    assert (t, bound) == (pytest.approx(1.0), "compute")
+    t, bound = costmodel.roofline_time_s(1e3, 1e8, 1e3, peaks=PEAKS)
+    assert (t, bound) == (pytest.approx(1.0), "memory")
+    t, bound = costmodel.roofline_time_s(1e3, 1e3, 1e7, peaks=PEAKS)
+    assert (t, bound) == (pytest.approx(1.0), "ici")
+
+
+# ---------------------------------------------------------------------------
+# the ledger join
+# ---------------------------------------------------------------------------
+
+def test_join_rows_rates_and_efficiency(clean_registry):
+    # 2 calls x 5e8 flops = 1e9 flops over 2.0s wall on a 1e9-flop/s
+    # roof: 0.5 GFLOP/s achieved, roofline time 1.0s, eff 0.5
+    costmodel.annotate("k", flops=1e9, lbytes=2e6, calls=2)
+    rows = [{"name": "k", "count": 2, "total_s": 2.0}]
+    costmodel.join_rows(rows, peaks=PEAKS)
+    r = rows[0]
+    assert r["annotated"] and r["bound"] == "compute"
+    assert r["flops"] == pytest.approx(1e9)
+    assert r["gflops_s"] == pytest.approx(0.5)
+    assert r["eff"] == pytest.approx(0.5)
+    assert r["gbytes_s"] == pytest.approx(2e6 / 2.0 / 1e9)
+
+
+def test_join_rows_unannotated_and_zero_wall(clean_registry):
+    costmodel.annotate("planned", cbytes=100, calls=0)
+    rows = [{"name": "mystery", "count": 1, "total_s": 1.0},
+            {"name": "planned", "count": 1, "total_s": 0.0}]
+    costmodel.join_rows(rows, peaks=PEAKS)
+    assert rows[0]["annotated"] is False
+    assert rows[0]["eff"] is None and rows[0]["gflops_s"] is None
+    # plan-time byte records: annotated but rate-free
+    assert rows[1]["annotated"] is True
+    assert rows[1]["eff"] is None and rows[1]["bound"] == "ici"
+
+
+def test_join_rows_efficiency_capped(clean_registry):
+    # grossly over-annotated work can't explode the fraction
+    costmodel.annotate("k", flops=1e15)
+    rows = [{"name": "k", "count": 1, "total_s": 0.001}]
+    costmodel.join_rows(rows, peaks=PEAKS)
+    assert rows[0]["eff"] == 99.0
+
+
+def test_attributable_fraction_weighted_by_wall(clean_registry):
+    costmodel.annotate("hot", flops=1)
+    rows = [{"name": "hot", "count": 1, "total_s": 9.0},
+            {"name": "cold", "count": 1, "total_s": 1.0}]
+    assert costmodel.attributable_fraction(rows) == pytest.approx(0.9)
+    assert costmodel.attributable_fraction([]) == 1.0
+
+
+def test_efficiency_summary_shape_and_weighting(clean_registry):
+    costmodel.annotate("a", flops=1e9)          # eff 1.0 over 1s
+    costmodel.annotate("b", flops=1e9)          # eff 0.25 over 4s
+    rows = [{"name": "a", "count": 1, "total_s": 1.0},
+            {"name": "b", "count": 1, "total_s": 4.0},
+            {"name": "c", "count": 1, "total_s": 5.0}]
+    s = costmodel.efficiency_summary(rows, peaks=PEAKS)
+    assert s["attributable_frac"] == pytest.approx(0.5)
+    # wall-weighted: (1*1.0 + 4*0.25) / 5
+    assert s["eff"] == pytest.approx(0.4)
+    assert s["annotated_names"] == 2 and s["names"] == 3
+    assert s["bound_wall_s"] == {"compute": 5.0}
+    assert s["backend"] == "test"
+
+
+def test_efficiency_by_groups_and_skips(clean_registry):
+    costmodel.annotate("serve.bfs/w32", flops=1e9)
+    costmodel.annotate("serve.cc/w8", flops=1e9)
+    rows = [{"name": "serve.bfs/w32", "count": 1, "total_s": 2.0},
+            {"name": "serve.cc/w8", "count": 1, "total_s": 1.0},
+            {"name": "other", "count": 1, "total_s": 1.0}]
+    kinds = costmodel.efficiency_by(
+        lambda n: n.split(".", 1)[1].split("/", 1)[0]
+        if n.startswith("serve.") else None,
+        rows, peaks=PEAKS)
+    assert kinds == {"bfs": pytest.approx(0.5),
+                     "cc": pytest.approx(1.0)}
+
+
+# ---------------------------------------------------------------------------
+# family annotators
+# ---------------------------------------------------------------------------
+
+def test_annotate_matrix_tuple_and_name_filter(clean_registry):
+    costmodel.annotate_matrix((1000, 64), names=("spmv.spmv",), calls=2)
+    assert costmodel.registry_size() == 1
+    c = costmodel.cost_for("spmv.spmv")
+    assert c["calls"] == 2
+    assert c["flops"] == pytest.approx(2.0 * 1000)          # per call
+    assert c["lbytes"] == pytest.approx(16.0 * 1000 + 8.0 * 64)
+
+
+def test_annotate_matrix_skips_traced_nnz(clean_registry):
+    class Traced:
+        def getnnz(self):
+            raise RuntimeError("tracer: no host readback")
+        nrows = 8
+
+    costmodel.annotate_matrix(Traced())     # must not raise
+    assert costmodel.registry_size() == 0
+
+
+def test_annotate_matrix_registers_every_family(clean_registry):
+    costmodel.annotate_matrix((100, 10))
+    names = set(costmodel.snapshot())
+    assert {"spmv.spmv", "spmv.spmsv", "bfs.bfs", "bfs.bits",
+            "bfs.plan_core", "bfs.stats_readback"} <= names
+
+
+# ---------------------------------------------------------------------------
+# e2e: the attribution contract on real runs
+# ---------------------------------------------------------------------------
+
+def _sparse(rng, m, n, density=0.4):
+    d = rng.random((m, n)).astype(np.float32)
+    d[rng.random((m, n)) > density] = 0.0
+    return d
+
+
+def test_phased_spgemm_ledger_is_90pct_attributable(
+        rng, obs_on, clean_registry):
+    """ISSUE acceptance: after a phased-SpGEMM run, >= 90% of the
+    ledger wall carries a cost annotation, and every colwindow-variant
+    executable the run dispatched is individually annotated."""
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    da = _sparse(rng, 48, 48)
+    a = DM.from_dense(S.PLUS, grid, da, 0.0)
+    SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=3)
+
+    rows = ledger.top_k(k=1 << 20)
+    assert rows, "phased run recorded nothing"
+    names = {r["name"] for r in rows}
+    assert any(n.startswith("spgemm.colwindow") for n in names)
+    for n in names:
+        if n.startswith(("spgemm.colwindow", "spgemm.sort_compress")):
+            assert costmodel.cost_for(n) is not None, n
+    frac = costmodel.attributable_fraction(rows)
+    assert frac >= 0.9, f"attributable_frac={frac:.3f} names={names}"
+    # and the artifact-embedded block agrees
+    blk = obs.dispatch_summary()["efficiency"]
+    assert blk["attributable_frac"] >= 0.9
+    assert blk["backend"] is not None
+
+
+def test_summa_bcast_names_are_annotated(rng, obs_on, clean_registry):
+    """Every spgemm.bcast/* exchange row the SUMMA path records at
+    plan time carries a cost annotation (cbytes), and the summa
+    executable itself is annotated."""
+    grid = ProcGrid.make(2, 4, jax.devices())
+    da = _sparse(rng, 24, 24)
+    a = DM.from_dense(S.PLUS, grid, da, 0.0)
+    b = DM.from_dense(S.PLUS, grid, da, 0.0)
+    SPG.spgemm(S.PLUS_TIMES_F32, a, b)
+
+    names = {r["name"] for r in ledger.top_k(k=1 << 20)}
+    bcasts = {n for n in names if n.startswith("spgemm.bcast/")}
+    assert bcasts, f"no exchange rows recorded: {names}"
+    for n in bcasts | {"spgemm.summa"}:
+        assert costmodel.cost_for(n) is not None, n
+    assert costmodel.attributable_fraction() >= 0.9
+
+
+def test_bfs_and_spmv_plan_time_registration(rng, obs_on,
+                                             clean_registry):
+    """Eager plan_bfs and the SpMV plan hook register every bfs.* /
+    spmv.* executable name the drivers dispatch."""
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.parallel import spmv as V
+
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    rows_i = np.array([0, 1, 2, 3, 0], dtype=np.int64)
+    cols_i = np.array([1, 2, 3, 0, 2], dtype=np.int64)
+    vals = np.ones(5, dtype=np.float32)
+    a = DM.from_global_coo(S.PLUS, grid, rows_i, cols_i, vals, 4, 4)
+
+    plan = B.plan_bfs(a)
+    B.bfs(a, 0, plan)
+    V.annotate_costs(a)
+
+    names = {r["name"] for r in ledger.top_k(k=1 << 20)}
+    assert any(n.startswith("bfs.") for n in names)
+    for n in names:
+        if n.startswith(("bfs.", "spmv")):
+            assert costmodel.cost_for(n) is not None, n
+    for n in V._SPMV_NAMES:
+        assert costmodel.cost_for(n) is not None, n

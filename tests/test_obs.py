@@ -598,6 +598,92 @@ def test_split_unaccounted_glue_vs_idle(obs_on):
     ledger.reset()
 
 
+def test_occupancy_degenerate_windows(obs_on):
+    """Empty span hull, zero-width window, and a window narrower than
+    one dispatch must all stay well-defined (no div-by-zero, busy
+    clipped to the window)."""
+    from combblas_tpu.obs import ledger, timeline
+
+    led = ledger.Ledger(capacity=8)
+    # no span records named "ghost": hull is empty
+    o = timeline.occupancy(span_name="ghost", ledger=led)
+    assert o == {"window_s": 0.0, "busy_s": 0.0,
+                 "busy_fraction": 0.0, "dispatches": 0}
+    # zero-width and inverted explicit windows
+    for t0, t1 in [(2.0, 2.0), (3.0, 2.0)]:
+        o = timeline.occupancy(t0=t0, t1=t1, ledger=led)
+        assert o["busy_fraction"] == 0.0 and o["window_s"] == 0.0
+    # one 10s dispatch, a 0.5s window strictly inside it: the clipped
+    # interval saturates the window exactly (fraction 1.0, not >1)
+    ledger.record("big", "dispatch", 0.0, 10.0, ledger=led)
+    o = timeline.occupancy(t0=4.0, t1=4.5, ledger=led)
+    assert o["window_s"] == pytest.approx(0.5)
+    assert o["busy_s"] == pytest.approx(0.5)
+    assert o["busy_fraction"] == pytest.approx(1.0)
+    assert o["dispatches"] == 1
+
+
+def test_occupancy_fully_overlapping_dispatches(obs_on):
+    """N identical dispatch intervals union to one: busy time counts
+    the covered wall once, while the dispatch count keeps all N."""
+    from combblas_tpu.obs import ledger, timeline
+
+    led = ledger.Ledger(capacity=8)
+    for _ in range(4):
+        ledger.record("dup", "dispatch", 1.0, 0.5, ledger=led)
+    o = timeline.occupancy(t0=0.0, t1=2.0, ledger=led)
+    assert o["busy_s"] == pytest.approx(0.5)
+    assert o["dispatches"] == 4
+    assert timeline.coverage(0.0, 2.0, ledger=led) == \
+        pytest.approx(0.25)
+
+
+def test_split_unaccounted_jittered_child_not_double_counted(obs_on):
+    """A child whose t0 lands a hair before its parent's (timer
+    jitter) is still subtracted from the parent's self time — the old
+    asymmetric filter dropped it, double-counting the child's wall as
+    parent residual."""
+    from combblas_tpu.obs import ledger, timeline
+
+    tr = trace.Tracer()
+    parent = _rec("glue", None, 1.0, 2.0, 1, ("glue",),
+                  children_s=0.5)
+    # child starts 0.2ns BEFORE the parent timestamp and overhangs
+    # the end by the same jitter: tolerated on both edges, clipped
+    # to the parent window
+    child = _rec("kid", "local", 1.0 - 2e-10, 1.5 + 2e-10, 2,
+                 ("glue", "kid"))
+    tr.records = [parent, child]
+    led = ledger.Ledger(capacity=4)
+    split = timeline.split_unaccounted(tracer=tr, ledger=led)
+    # self time is exactly the uncovered half; nothing overlaps a
+    # ledger record, so it is all host idle
+    assert split["unaccounted_s"] == pytest.approx(0.5, abs=1e-6)
+    assert split["host_idle_s"] == pytest.approx(0.5, abs=1e-6)
+    assert split["dispatch_glue_s"] == 0.0
+
+
+def test_split_unaccounted_fully_covered_span(obs_on):
+    """A category-less span whose window sits entirely inside one
+    ledger dispatch is pure glue (zero idle); a child covering the
+    whole window leaves no residual at all."""
+    from combblas_tpu.obs import ledger, timeline
+
+    tr = trace.Tracer()
+    tr.records = [_rec("glue", None, 1.0, 2.0, 1, ("glue",))]
+    led = ledger.Ledger(capacity=4)
+    ledger.record("dispatch", "dispatch", 0.0, 5.0, ledger=led)
+    split = timeline.split_unaccounted(tracer=tr, ledger=led)
+    assert split["dispatch_glue_s"] == pytest.approx(1.0)
+    assert split["host_idle_s"] == 0.0
+    # fully-overlapping child: self intervals collapse to nothing
+    tr.records = [_rec("glue", None, 1.0, 2.0, 1, ("glue",),
+                        children_s=1.0),
+                   _rec("kid", "local", 1.0, 2.0, 2, ("glue", "kid"))]
+    split = timeline.split_unaccounted(tracer=tr, ledger=led)
+    assert split["unaccounted_s"] == 0.0
+
+
 def test_dispatch_summary_block_shape(obs_on):
     from combblas_tpu.obs import ledger
 

@@ -1,0 +1,274 @@
+"""Bench-trajectory normalization + regression detection
+(obs.regress): schema grading across the artifact generations, the
+per-family normalizers, trajectory build/load round-trip, and the
+direction-aware noise bands."""
+
+import json
+
+import pytest
+
+from combblas_tpu.obs import regress
+
+FULL_SUMMARY = {
+    "dispatches": 10, "readbacks": 2, "compiles": 3,
+    "recorded": 12, "dropped": 0,
+    "top": [{"name": "bfs.bits", "count": 8, "total_s": 0.5,
+             "arg_bytes": 1024}],
+    "efficiency": {"eff": 0.42, "attributable_frac": 0.95,
+                   "annotated_names": 1, "names": 1,
+                   "bound_wall_s": {"memory": 0.5}, "backend": "cpu"},
+}
+
+
+def _run(**kw):
+    row = {"run_id": "BENCH_r01", "artifact": "BENCH_r01.json",
+           "workload": "bfs", "seq": 1, "scale": 20, "backend": "cpu",
+           "wall_s": 1.0, "value": 2.0, "unit": "GTEPS",
+           "dispatches": 10, "compiles": 3, "exchanged_bytes": None,
+           "efficiency": 0.4, "attributable_frac": 0.9,
+           "unaccounted_s": 0.1, "schema": "full"}
+    row.update(kw)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# schema grading + the fresh-artifact gate
+# ---------------------------------------------------------------------------
+
+def test_classify_grades():
+    assert regress.classify({"dispatch_summary": FULL_SUMMARY,
+                             "unaccounted_s": 0.1}) == ("full", [])
+    grade, missing = regress.classify({"dispatch_summary": FULL_SUMMARY})
+    assert grade == "partial" and missing == ["unaccounted_s"]
+    grade, missing = regress.classify({"value": 1.0})
+    assert grade == "legacy"
+    assert set(missing) == {"dispatch_summary", "unaccounted_s"}
+    # nested summaries (serve/bits artifacts) still count
+    grade, _ = regress.classify(
+        {"closed_loop": {"dispatch_summary": FULL_SUMMARY}})
+    assert grade == "partial"
+
+
+def test_validate_artifact_rejects_and_allows():
+    full = {"dispatch_summary": FULL_SUMMARY, "unaccounted_s": 0.1}
+    assert regress.validate_artifact(full) == "full"
+    partial = {"dispatch_summary": FULL_SUMMARY}
+    with pytest.raises(regress.SchemaError, match="unaccounted_s"):
+        regress.validate_artifact(partial, "P.json")
+    assert regress.validate_artifact(partial, allow_partial=True) == \
+        "partial"
+    with pytest.raises(regress.SchemaError, match="dispatch_summary"):
+        regress.validate_artifact({"value": 1.0}, "L.json",
+                                  allow_partial=True)
+
+
+# ---------------------------------------------------------------------------
+# per-family normalizers
+# ---------------------------------------------------------------------------
+
+def test_normalize_bfs_parsed_wrapper_and_metric_scale():
+    doc = {"parsed": {"metric": "bfs_scale22_ef16_gteps",
+                      "value": 0.75, "unit": "GTEPS"},
+           "platform": "cpu"}
+    row = regress.normalize_artifact("BENCH_r03.json", doc)
+    assert row["workload"] == "bfs" and row["seq"] == 3
+    assert row["scale"] == 22           # parsed out of the metric name
+    assert row["value"] == 0.75 and row["unit"] == "GTEPS"
+    assert row["schema"] == "legacy" and row["backend"] == "cpu"
+
+
+def test_normalize_bits_speedup_fallback():
+    doc = {"per_root_speedup": 3.3, "scale": 10, "wall_s": 0.16,
+           "dispatch_summary": FULL_SUMMARY}
+    row = regress.normalize_artifact("BITS_BENCH.json", doc)
+    assert row["workload"] == "bits"
+    assert row["value"] == 3.3 and row["unit"] == "x_per_root"
+    assert row["schema"] == "partial"
+    assert row["efficiency"] == 0.42
+    assert row["attributable_frac"] == 0.95
+
+
+def test_normalize_mcl_wall_from_seconds_value():
+    doc = {"value": 134.5, "unit": "s", "n": 4096,
+           "dispatch_summary": FULL_SUMMARY, "unaccounted_s": 2.5}
+    row = regress.normalize_artifact("MCL_BENCH_r06.json", doc)
+    assert row["workload"] == "mcl" and row["seq"] == 6
+    assert row["wall_s"] == 134.5
+    assert row["scale"] == 12           # log2(n)
+    assert row["schema"] == "full" and row["unaccounted_s"] == 2.5
+
+
+def test_normalize_serve_nested_wall_and_exchange_bytes():
+    summary = dict(FULL_SUMMARY)
+    summary["top"] = [{"name": "spgemm.bcast/dense", "count": 4,
+                       "total_s": 0.0, "arg_bytes": 4096},
+                      {"name": "spmv.fanout", "count": 2,
+                       "total_s": 0.1, "arg_bytes": 512}]
+    doc = {"closed_loop": {"wall_s": 0.9, "dispatch_summary": summary}}
+    row = regress.normalize_artifact("SERVE_BENCH.json", doc)
+    assert row["workload"] == "serve" and row["wall_s"] == 0.9
+    assert row["exchanged_bytes"] == 4096 + 512
+    assert row["dispatches"] == 10 and row["compiles"] == 3
+
+
+def test_normalize_multichip_wall_and_hybrid_bytes():
+    doc = {"spgemm": {"wall_auto_s": 34.9, "hybrid_bytes": 1 << 20},
+           "platform": "cpu"}
+    row = regress.normalize_artifact("MULTICHIP_r06.json", doc)
+    assert row["workload"] == "multichip"
+    assert row["wall_s"] == 34.9
+    assert row["exchanged_bytes"] == 1 << 20
+
+
+def test_normalize_rejects_unknown_artifact():
+    with pytest.raises(regress.SchemaError, match="not a recognized"):
+        regress.normalize_artifact("NOTES.json", {})
+    with pytest.raises(regress.SchemaError, match="must be an object"):
+        regress.normalize_artifact("BENCH_r01.json", [1, 2])
+
+
+def test_workload_of_glob_order():
+    assert regress.workload_of("BENCH_r05.json") == "bfs"
+    assert regress.workload_of("MCL_BENCH_r04.json") == "mcl"
+    assert regress.workload_of("SERVE_BENCH.json") == "serve"
+    assert regress.workload_of("random.json") is None
+
+
+# ---------------------------------------------------------------------------
+# canonical-row validation
+# ---------------------------------------------------------------------------
+
+def test_validate_run_rejections():
+    regress.validate_run(_run())            # the happy row validates
+    with pytest.raises(regress.SchemaError, match="required field"):
+        regress.validate_run(_run(workload=None))
+    with pytest.raises(regress.SchemaError, match="unknown schema"):
+        regress.validate_run(_run(schema="vibes"))
+    with pytest.raises(regress.SchemaError, match="unknown fields"):
+        regress.validate_run(_run(extra=1))
+    with pytest.raises(regress.SchemaError, match="not numeric"):
+        regress.validate_run(_run(wall_s="fast"))
+    with pytest.raises(regress.SchemaError):
+        regress.validate_run("not a dict")
+
+
+# ---------------------------------------------------------------------------
+# trajectory build / load
+# ---------------------------------------------------------------------------
+
+def test_build_trajectory_deterministic_and_round_trips(tmp_path):
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"parsed": {"metric": "bfs_scale20_gteps", "value": 0.03,
+                    "unit": "GTEPS"}}))
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"metric": "bfs_scale20_gteps", "value": 0.01,
+                    "unit": "GTEPS"}}))
+    (tmp_path / "MCL_BENCH_r01.json").write_text(json.dumps(
+        {"value": 10.0, "unit": "s", "scale": 8,
+         "dispatch_summary": FULL_SUMMARY, "unaccounted_s": 0.5}))
+    traj = regress.build_trajectory(tmp_path)
+    assert traj["schema"] == regress.SCHEMA_VERSION
+    assert [r["run_id"] for r in traj["runs"]] == \
+        ["BENCH_r01", "BENCH_r02", "MCL_BENCH_r01"]
+    assert traj == regress.build_trajectory(tmp_path)   # deterministic
+    p = tmp_path / "BENCH_TRAJECTORY.json"
+    p.write_text(json.dumps(traj))
+    assert regress.load_trajectory(p) == traj
+
+
+def test_build_trajectory_unreadable_artifact_raises(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    with pytest.raises(regress.SchemaError, match="unreadable"):
+        regress.build_trajectory(tmp_path)
+
+
+def test_load_trajectory_rejects_wrong_schema(tmp_path):
+    p = tmp_path / "T.json"
+    p.write_text(json.dumps({"schema": "bench-trajectory/v0",
+                             "runs": []}))
+    with pytest.raises(regress.SchemaError, match="expected schema"):
+        regress.load_trajectory(p)
+    p.write_text(json.dumps({"schema": regress.SCHEMA_VERSION,
+                             "runs": [{"run_id": "x"}]}))
+    with pytest.raises(regress.SchemaError):    # rows validated too
+        regress.load_trajectory(p)
+
+
+def test_committed_trajectory_matches_committed_artifacts():
+    """The repo-root BENCH_TRAJECTORY.json is exactly what
+    bench_registry would rebuild — drift fails here AND in pass 5."""
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parents[1]
+    committed = regress.load_trajectory(root / "BENCH_TRAJECTORY.json")
+    assert committed["runs"] == regress.build_trajectory(root)["runs"]
+
+
+# ---------------------------------------------------------------------------
+# regression bands
+# ---------------------------------------------------------------------------
+
+def _traj(*runs):
+    return {"schema": regress.SCHEMA_VERSION, "runs": list(runs)}
+
+
+def test_compare_higher_direction_fires_and_passes():
+    traj = _traj(_run(run_id="BENCH_r01", seq=1, value=2.0))
+    ok = _run(run_id="BENCH_r02", seq=2, value=1.6)     # within 25%
+    assert regress.compare(ok, traj) == []
+    bad = _run(run_id="BENCH_r02", seq=2, value=1.0)
+    v = regress.compare(bad, traj)
+    assert len(v) == 1
+    assert v[0]["metric"] == "value" and v[0]["baseline"] == 2.0
+    assert "regressed" in v[0]["message"]
+
+
+def test_compare_lower_direction_band():
+    bands = [{"workload": "mcl", "metric": "wall_s",
+              "direction": "lower", "band_frac": 0.5}]
+    traj = _traj(_run(run_id="MCL_BENCH_r01", workload="mcl",
+                      artifact="MCL_BENCH_r01.json", seq=1,
+                      wall_s=100.0, value=None, unit="s"))
+    ok = _run(run_id="MCL_BENCH_r02", workload="mcl",
+              artifact="MCL_BENCH_r02.json", seq=2, wall_s=140.0,
+              value=None, unit="s")
+    assert regress.compare(ok, traj, bands) == []
+    bad = dict(ok, wall_s=200.0)
+    v = regress.compare(bad, traj, bands)
+    assert len(v) == 1 and v[0]["direction"] == "lower"
+
+
+def test_compare_restricts_to_same_scale_when_available():
+    traj = _traj(_run(run_id="BENCH_r01", seq=1, scale=20, value=0.1),
+                 _run(run_id="BENCH_r02", seq=2, scale=22, value=4.0))
+    # scale-20 fresh run compares against the scale-20 prior only:
+    # 0.09 is within 25% of 0.1 (but would fail against 4.0)
+    fresh = _run(run_id="BENCH_r03", seq=3, scale=20, value=0.09)
+    assert regress.compare(fresh, traj) == []
+    # unseen scale: the whole-workload pool is the fallback baseline
+    fresh = _run(run_id="BENCH_r03", seq=3, scale=24, value=0.09)
+    assert len(regress.compare(fresh, traj)) == 1
+
+
+def test_compare_excludes_self_and_skips_nones():
+    traj = _traj(_run(run_id="BENCH_r02", seq=2, value=9.9),
+                 _run(run_id="BENCH_r01", seq=1, value=None))
+    # the fresh run's own committed row is not its baseline; the
+    # remaining pool has no numeric value -> no verdicts
+    fresh = _run(run_id="BENCH_r02", seq=2, value=9.9)
+    assert regress.compare(fresh, traj) == []
+    # a None fresh metric never trips a band
+    fresh = _run(run_id="BENCH_r03", seq=3, value=None)
+    assert regress.compare(fresh, traj) == []
+
+
+def test_newest_runs_by_seq():
+    traj = _traj(_run(run_id="BENCH_r01", seq=1),
+                 _run(run_id="BENCH_r05", seq=5),
+                 _run(run_id="MCL_BENCH_r06", workload="mcl",
+                      artifact="MCL_BENCH_r06.json", seq=6),
+                 _run(run_id="SERVE_BENCH", workload="serve",
+                      artifact="SERVE_BENCH.json", seq=None))
+    newest = regress.newest_runs(traj)
+    assert newest["bfs"]["run_id"] == "BENCH_r05"
+    assert newest["mcl"]["run_id"] == "MCL_BENCH_r06"
+    assert newest["serve"]["run_id"] == "SERVE_BENCH"
