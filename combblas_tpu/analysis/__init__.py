@@ -1,7 +1,7 @@
 """`combblas_tpu.analysis` — static-analysis gate for the repo's
 structural invariants.
 
-Five passes, one verdict (see `scripts/analyze.py --gate` and the
+Six passes, one verdict (see `scripts/analyze.py --gate` and the
 README "Static analysis" section):
 
 1. **Budget engine** (`budget.run_budgets`) — lowers registered
@@ -30,6 +30,12 @@ README "Static analysis" section):
    `budgets/perf_regression.json`: trajectory coverage/staleness,
    roofline-efficiency floors on schema-full runs, and direction-aware
    noise bands around each workload's newest-vs-baseline runs.
+6. **memory-budget gate** (`membudget.run_mem`) — committed OOM-risk
+   ceilings over bench `memory_summary` blocks (`budgets/memory.json`):
+   per-executable XLA temp-scratch ceilings, peak footprint as a
+   fraction of the backend's `hbm_bytes`, footprint-census coverage
+   floors, and the donation contract (no declared `donate_argnums`
+   the compiled executable silently ignored).
 
 All passes are trace/AST/JSON only — nothing here compiles or
 executes device code — and every finding carries `file:line`, a rule
@@ -69,8 +75,13 @@ def run_perf(**kw):
     return perfgate.run_perf(**kw)
 
 
-def run_all(passes=("budgets", "retrace", "locks", "obs", "perf")) \
-        -> list[Finding]:
+def run_mem(**kw):
+    from combblas_tpu.analysis import membudget
+    return membudget.run_mem(**kw)
+
+
+def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
+                    "mem")) -> list[Finding]:
     """Run the selected passes; returns all unsuppressed findings
     (empty = gate passes)."""
     out: list[Finding] = []
@@ -84,4 +95,6 @@ def run_all(passes=("budgets", "retrace", "locks", "obs", "perf")) \
         out += run_obs()
     if "perf" in passes:
         out += run_perf()
+    if "mem" in passes:
+        out += run_mem()
     return out

@@ -57,12 +57,26 @@ PERF_STALE = "perf-stale-trajectory"        # BENCH_TRAJECTORY.json missing,
 #                                             unreadable, or not covering a
 #                                             committed artifact
 
+# memory-budget gate over bench memory_summary blocks (pass 6)
+MEM_TEMP = "mem-temp-ceiling"            # per-executable temp bytes over
+#                                          the committed ceiling
+MEM_PEAK = "mem-peak-budget"             # per-bench peak footprint over
+#                                          the committed HBM fraction
+MEM_DONATION = "mem-donation-unhonored"  # declared donate_argnums the
+#                                          compiled executable ignored
+MEM_CENSUS = "mem-census-coverage"       # footprint census coverage of
+#                                          the dispatch ledger below floor
+MEM_STALE = "mem-stale-artifact"         # memory budget names an artifact
+#                                          / executable / donation that no
+#                                          longer exists
+
 ALL_RULES = (
     SORT_COUNT, SORT_ARITY, OP_CEILING, FORBID_DTYPE, FORBID_OP,
     LANE_INVARIANCE, RETRACE_DRIFT, RETRACE_PY_SCALAR,
     RETRACE_EXTRA_COMPILE, LOCK_CYCLE, JIT_UNDER_LOCK, BARE_ACQUIRE,
     OBS_RESIDUAL, OBS_DISPATCH_COUNT, OBS_STALE,
     PERF_EFFICIENCY, PERF_REGRESSION, PERF_STALE,
+    MEM_TEMP, MEM_PEAK, MEM_DONATION, MEM_CENSUS, MEM_STALE,
 )
 
 

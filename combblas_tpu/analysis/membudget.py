@@ -1,0 +1,225 @@
+"""Pass 6 — memory-budget gate over bench `memory_summary` blocks.
+
+The memory ledger (`combblas_tpu.obs.memledger`) gives every bench
+artifact a `memory_summary` block: compile-time footprint census
+(argument/output/temp bytes per executable, from XLA's own
+memory_analysis), live-buffer watermarks, and the donation audit
+(declared `donate_argnums` vs the aliases the compiled executable
+actually honors). This pass commits that progress as an OOM-risk gate:
+declarative ceilings in `analysis/budgets/memory.json` pin, per
+artifact,
+
+* per-executable TEMP-byte ceilings (`temp_ceilings`) — XLA scratch is
+  the silent OOM driver: it appears in no array the program names, so
+  a fusion regression that doubles scratch shows up nowhere else;
+* the peak footprint as a FRACTION of the backend's committed
+  `hbm_bytes` (`peak_frac_max`) — the worst of measured live-buffer
+  peak and largest single-executable footprint must leave headroom;
+* census coverage of the dispatch ledger (`census_coverage_min`) — a
+  run whose compiled executables stopped landing in the census is
+  flying blind, so coverage decay fails the gate, not a future OOM;
+* the donation contract: any `donation_audit.unhonored` entry fails
+  (a declared donation XLA silently ignored is a leaked buffer at
+  every dispatch), and `donations_required` names must stay declared
+  and never-unhonored (dropping the declaration is STALE).
+
+Budget JSON shape (one file may pin several artifacts)::
+
+    {"artifacts": [{
+        "artifact": "ESC_MICROBENCH.json",  # repo-root relative; "*"
+                                            # globs pick newest by mtime
+        "driver": "esc",
+        "require_memory_summary": true,     # false tolerates artifacts
+                                            # recorded before the ledger
+        "census_coverage_min": 0.9,
+        "peak_frac_max": 0.5,
+        "temp_ceilings": {"spgemm.colwindow": 8000000},
+        "donations_required": ["spgemm.shrink_place3"],
+        "allow": []                         # waived rule ids
+    }]}
+
+All checks are pure JSON reads — nothing here compiles or runs device
+code. Ceilings are maxima (dropping below is improvement); the STALE
+rule keeps the committed expectations honest in both directions.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+from combblas_tpu.analysis.obsbudget import (
+    _line_of, _load_artifact, _resolve_artifact,
+)
+
+BUDGET_DIR = pathlib.Path(__file__).parent / "budgets"
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def _collect_memory_summaries(doc, out=None) -> list:
+    """All `memory_summary` blocks anywhere in the artifact (serve
+    artifacts nest one per mode, same convention as dispatch_summary)."""
+    if out is None:
+        out = []
+    if isinstance(doc, dict):
+        ms = doc.get("memory_summary")
+        if isinstance(ms, dict):
+            out.append(ms)
+        for v in doc.values():
+            _collect_memory_summaries(v, out)
+    elif isinstance(doc, list):
+        for v in doc:
+            _collect_memory_summaries(v, out)
+    return out
+
+
+def _temp_by_name(summaries: list) -> dict:
+    """executable name -> max temp bytes across summaries' top tables."""
+    out: dict = {}
+    for ms in summaries:
+        for row in ms.get("top", []):
+            name = row.get("name")
+            if name:
+                out[name] = max(out.get(name, 0),
+                                int(row.get("temp_bytes", 0)))
+    return out
+
+
+def check_artifact(ent: dict, budget_text: str, budget_path: str,
+                   root=None) -> list[Finding]:
+    """All findings for one memory-budget entry (the unit the
+    self-test fixtures drive)."""
+    allow = set(ent.get("allow", []))
+    name = ent["artifact"]
+    driver = ent.get("driver", name)
+    findings: list[Finding] = []
+
+    def add(rule, key, msg):
+        if rule not in allow:
+            findings.append(Finding(
+                rule, budget_path, _line_of(budget_text, name, key),
+                msg, entry=driver))
+
+    path = _resolve_artifact(name, pathlib.Path(root or REPO_ROOT))
+    if path is None:
+        add(core.MEM_STALE, "artifact",
+            f"artifact {name!r} not found — the committed memory "
+            "budget is stale")
+        return findings
+    try:
+        art = _load_artifact(path)
+    except ValueError as e:
+        add(core.MEM_STALE, "artifact", f"artifact unreadable: {e}")
+        return findings
+
+    summaries = _collect_memory_summaries(art)
+    if not summaries:
+        if ent.get("require_memory_summary"):
+            add(core.MEM_STALE, "require_memory_summary",
+                f"{path.name}: no memory_summary block — rerun the "
+                "bench with the memory ledger on (obs.export."
+                "memory_summary next to dispatch_summary)")
+        return findings
+
+    # -- census coverage floor ------------------------------------------
+    floor = ent.get("census_coverage_min")
+    if floor is not None:
+        fracs = [float(ms["census_coverage"]["frac"]) for ms in summaries
+                 if isinstance(ms.get("census_coverage"), dict)
+                 and "frac" in ms["census_coverage"]]
+        if not fracs:
+            add(core.MEM_STALE, "census_coverage_min",
+                f"{path.name}: memory_summary has no census_coverage "
+                "block — the artifact shape drifted from the budget")
+        elif min(fracs) < float(floor):
+            add(core.MEM_CENSUS, "census_coverage_min",
+                f"{path.name}: footprint census covered "
+                f"{min(fracs):.0%} of compiled ledger executables "
+                f"(floor {float(floor):.0%}) — compile-time memory "
+                "attribution regressed")
+
+    # -- peak footprint vs committed HBM fraction -----------------------
+    frac_max = ent.get("peak_frac_max")
+    if frac_max is not None:
+        worst_frac, worst = 0.0, None
+        for ms in summaries:
+            cap = float(ms.get("hbm_bytes") or 0)
+            if cap <= 0:
+                continue
+            peak = max(int(ms.get("peak_resident_bytes", 0)),
+                       int(ms.get("largest_footprint_bytes", 0)))
+            if peak / cap > worst_frac:
+                worst_frac, worst = peak / cap, peak
+        if worst is None:
+            add(core.MEM_STALE, "peak_frac_max",
+                f"{path.name}: no memory_summary carries hbm_bytes — "
+                "cannot judge the committed peak fraction")
+        elif worst_frac > float(frac_max):
+            add(core.MEM_PEAK, "peak_frac_max",
+                f"{path.name}: peak footprint {worst} B is "
+                f"{worst_frac:.1%} of the backend's HBM (ceiling "
+                f"{float(frac_max):.0%}) — the bench is drifting "
+                "toward OOM; see top_footprints for the claimants")
+
+    # -- per-executable temp ceilings -----------------------------------
+    temps = _temp_by_name(summaries)
+    for ex, ceil in (ent.get("temp_ceilings") or {}).items():
+        if ex not in temps:
+            add(core.MEM_STALE, ex,
+                f"{path.name}: temp ceiling names {ex!r} but no "
+                "memory_summary footprint matches — the executable was "
+                "renamed or fell out of the top table; update the "
+                "budget")
+        elif temps[ex] > int(ceil):
+            add(core.MEM_TEMP, ex,
+                f"{path.name}: executable {ex!r} temp scratch "
+                f"{temps[ex]} B exceeds the committed ceiling "
+                f"{int(ceil)} B — an XLA fusion/layout change grew "
+                "silent scratch")
+
+    # -- donation contract ----------------------------------------------
+    audits = [ms["donation_audit"] for ms in summaries
+              if isinstance(ms.get("donation_audit"), dict)]
+    unhonored = sorted({n for a in audits
+                        for n in a.get("unhonored", [])})
+    for n in unhonored:
+        add(core.MEM_DONATION, "artifact",
+            f"{path.name}: declared donation on {n!r} was NOT honored "
+            "by the compiled executable (no aliased parameter) — the "
+            "input buffer is retained at every dispatch; fix the "
+            "donation or declare a waiver at the declaration site")
+    required = ent.get("donations_required") or []
+    if required and not audits:
+        add(core.MEM_STALE, "donations_required",
+            f"{path.name}: donations are required but no "
+            "memory_summary carries a donation_audit block")
+    for want in required:
+        declared = {e["name"] for a in audits
+                    for e in a.get("entries", [])}
+        if not audits:
+            break
+        if want not in declared:
+            add(core.MEM_STALE, "donations_required",
+                f"{path.name}: required donation {want!r} is no longer "
+                "declared — the declare_donation call was dropped or "
+                "renamed")
+    return findings
+
+
+def run_mem(files=None, root=None) -> list[Finding]:
+    """Run the memory-budget pass over the committed budgets (or an
+    explicit fixture list); returns unsuppressed findings."""
+    paths = ([pathlib.Path(f) for f in files] if files is not None
+             else sorted(BUDGET_DIR.glob("memory*.json")))
+    findings: list[Finding] = []
+    for p in paths:
+        text = p.read_text()
+        data = json.loads(text)
+        for ent in data.get("artifacts", []):
+            if "artifact" not in ent:
+                raise ValueError(f"{p}: memory budget entry without "
+                                 "'artifact'")
+            findings += check_artifact(ent, text, str(p), root=root)
+    return findings

@@ -208,6 +208,12 @@ def _megastep_body(a: dm.DistSpMat, *, power: float,
 _megastep = jax.jit(_megastep_body, static_argnames=("power", "new_cap"),
                     donate_argnums=(0,))
 _megastep = obs.instrument(_megastep, "mcl.megastep")
+# donation audit: the donated matrix carry is what lets consecutive
+# iterations run in-place. min_honored=1 (not full-leaf): a `new_cap`
+# re-pin changes buffer shapes, so XLA can legally alias only the
+# leaves whose layout survives — the audit asserts the carry is not
+# SILENTLY copy-everything, not that every leaf aliases.
+obs.memledger.declare_donation("mcl.megastep", (0,), min_honored=1)
 
 
 @partial(jax.jit, static_argnames=("p",))

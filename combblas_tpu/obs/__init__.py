@@ -14,6 +14,11 @@ accumulators. This subsystem supersedes them:
   ledger names with expected flops/bytes at plan time; `top_k` /
   `format_table` / `/varz` join them into achieved FLOP/s, B/s, and
   efficiency fractions against `utils.config.backend_peaks`;
+* `obs.memledger` — HBM memory ledger: compile-time footprint census
+  (`CompiledMemoryStats` per executable, claimed by `instrument`
+  wrappers), live-buffer watermarks via `jax.live_arrays()`, and the
+  `donate_argnums` honor audit — the capacity axis of the roofline,
+  gated by analysis pass 6;
 * `obs.regress` — canonical bench trajectory (BENCH_TRAJECTORY.json)
   normalizers and the noise-banded regression detector behind
   `scripts/bench_registry.py` and analysis pass 5.
@@ -35,7 +40,8 @@ Quick start::
 """
 
 from combblas_tpu.obs import (
-    costmodel, export, httpd, ledger, metrics, regress, timeline, trace,
+    costmodel, export, httpd, ledger, memledger, metrics, regress,
+    timeline, trace,
 )
 from combblas_tpu.obs.trace import (
     CATEGORIES, TRACER, Tracer, current_path, enabled, get_trace_id,
@@ -43,8 +49,9 @@ from combblas_tpu.obs.trace import (
 )
 from combblas_tpu.obs.metrics import REGISTRY, counter, gauge, histogram
 from combblas_tpu.obs.export import (
-    chrome_trace, dispatch_summary, format_report, phase_breakdown,
-    profiler_trace, report, read_jsonl, read_jsonl_metrics, to_jsonl,
+    chrome_trace, dispatch_summary, format_report, memory_summary,
+    phase_breakdown, profiler_trace, report, read_jsonl,
+    read_jsonl_metrics, to_jsonl,
 )
 from combblas_tpu.obs.ledger import LEDGER, Ledger, instrument
 from combblas_tpu.obs.httpd import (

@@ -225,6 +225,24 @@ def efficiency_by(key_fn, rows=None, ledger=None, peaks=None) -> dict:
     return {k: round(num[k] / den[k], 4) for k in num if den[k] > 0}
 
 
+def capacity_summary(peaks=None, k: int = 5) -> dict:
+    """The CAPACITY side of the roofline (companion to
+    `efficiency_summary`'s rate side): the backend's `hbm_bytes`
+    ceiling against the memledger's measured peak-resident bytes and
+    largest compile-time footprint, plus the top-K footprints by temp
+    bytes. {hbm_bytes, peak_resident_bytes, largest_footprint_bytes,
+    headroom_frac, backend, top_footprints}."""
+    if peaks is None:
+        from combblas_tpu.utils.config import backend_peaks
+        peaks = backend_peaks()
+    from combblas_tpu.obs import memledger as _memledger
+    return {
+        **_memledger.headroom(peaks),
+        "backend": peaks.name,
+        "top_footprints": _memledger.top_footprints(k),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Family annotators (per-call nnz-proportional models)
 # ---------------------------------------------------------------------------

@@ -176,8 +176,13 @@ def refresh_obs_gauges() -> None:
     the recorder silently truncated and dispatch counts under-report),
     `obs.ledger_total`, `obs.ledger_capacity`,
     `obs.instrumented_registry_size`, and
-    `obs.costmodel_registry_size` (annotated-name count)."""
+    `obs.costmodel_registry_size` (annotated-name count) — plus the
+    memory ledger's capacity gauges: `obs.mem_peak_resident_bytes`
+    (high-water live-buffer sample), `obs.mem_census_executables`
+    (compiles seen by the footprint census), and
+    `obs.mem_headroom_frac` (1 - worst(peak, largest footprint)/HBM)."""
     from combblas_tpu.obs import costmodel as _costmodel
+    from combblas_tpu.obs import memledger as _memledger
     led = _ledger.LEDGER
     _metrics.gauge("obs.ledger_dropped",
                    "dispatch records lost to ring wrap").set(led.dropped)
@@ -191,14 +196,29 @@ def refresh_obs_gauges() -> None:
     _metrics.gauge("obs.costmodel_registry_size",
                    "ledger names with cost annotations").set(
         _costmodel.registry_size())
+    hr = _memledger.headroom()
+    _metrics.gauge("obs.mem_peak_resident_bytes",
+                   "peak live-buffer bytes sampled").set(
+        hr["peak_resident_bytes"])
+    _metrics.gauge("obs.mem_census_executables",
+                   "compiles recorded by the footprint census").set(
+        _memledger.census_len())
+    if hr["headroom_frac"] is not None:
+        _metrics.gauge("obs.mem_headroom_frac",
+                       "1 - worst(peak, largest footprint) / hbm_bytes"
+                       ).set(hr["headroom_frac"])
 
 
 def varz_snapshot(extra=None, top_k: int = 10) -> dict:
     """JSON-ready full snapshot: metrics registry + ledger top-K (with
-    the roofline join) + cost-model coverage + whatever the hosting
-    service adds via `extra()` (e.g. GraphService stats/plan-cache hit
-    rates)."""
+    the roofline join) + cost-model coverage + the memory ledger's
+    capacity block (headroom, census stats, top footprints — NOT the
+    donation audit, which re-walks the census per declared name and
+    stays off the scrape path; fetch it via `export.memory_summary`)
+    + whatever the hosting service adds via `extra()` (e.g.
+    GraphService stats/plan-cache hit rates)."""
     from combblas_tpu.obs import costmodel as _costmodel
+    from combblas_tpu.obs import memledger as _memledger
     refresh_obs_gauges()
     led = _ledger.LEDGER
     out = {
@@ -215,6 +235,12 @@ def varz_snapshot(extra=None, top_k: int = 10) -> dict:
         "costmodel": {
             "registry_size": _costmodel.registry_size(),
             "efficiency": _costmodel.efficiency_summary(),
+        },
+        "memory": {
+            **_memledger.headroom(),
+            "census": _memledger.census_stats(),
+            "watermark_samples": _memledger.watermark_samples(),
+            "top_footprints": _memledger.top_footprints(top_k),
         },
     }
     if extra is not None:

@@ -46,6 +46,7 @@ import itertools
 import threading
 import time
 
+from combblas_tpu.obs import memledger as _memledger
 from combblas_tpu.obs import trace as _trace
 
 _LEDGER_ON = True   # sub-switch: ledger active iff this AND trace._ENABLED
@@ -67,10 +68,11 @@ class DispatchRecord:
 
     __slots__ = ("seq", "name", "kind", "t0", "wall_s", "arg_shapes",
                  "arg_bytes", "out_bytes", "compiled", "path", "tid",
-                 "trace_id", "t_enq")
+                 "trace_id", "t_enq", "mem_bytes")
 
     def __init__(self, seq, name, kind, t0, wall_s, arg_shapes, arg_bytes,
-                 out_bytes, compiled, path, tid, trace_id, t_enq=None):
+                 out_bytes, compiled, path, tid, trace_id, t_enq=None,
+                 mem_bytes=None):
         self.seq = seq
         self.name = name
         self.kind = kind              # "dispatch" | "readback"
@@ -84,6 +86,9 @@ class DispatchRecord:
         self.tid = tid
         self.trace_id = trace_id
         self.t_enq = t_enq            # enqueue stamp (deferred readbacks)
+        self.mem_bytes = mem_bytes    # compile-time footprint ceiling of
+        #                               executables THIS call compiled
+        #                               (memledger census; None otherwise)
 
     def to_dict(self) -> dict:
         return {"seq": self.seq, "name": self.name, "kind": self.kind,
@@ -92,7 +97,7 @@ class DispatchRecord:
                 "arg_bytes": self.arg_bytes, "out_bytes": self.out_bytes,
                 "compiled": self.compiled, "path": list(self.path),
                 "tid": self.tid, "trace_id": self.trace_id,
-                "t_enq": self.t_enq}
+                "t_enq": self.t_enq, "mem_bytes": self.mem_bytes}
 
     def __repr__(self):
         return (f"DispatchRecord(#{self.seq} {self.name} {self.kind} "
@@ -277,6 +282,10 @@ def instrument(fn, name: str, *, kind: str = "dispatch",
         raise ValueError(f"unknown ledger kind {kind!r}")
     cache_size = getattr(fn, "_cache_size", None)
     led = ledger if ledger is not None else LEDGER
+    # arm the compile-time footprint census once any boundary is
+    # instrumented: compiles triggered inside the wrapper get claimed
+    # under `name` below (innermost wrapper wins for nested wraps)
+    _memledger.ensure_installed()
 
     def wrapper(*args, **kwargs):
         if not (_LEDGER_ON and _trace._ENABLED):
@@ -284,6 +293,7 @@ def instrument(fn, name: str, *, kind: str = "dispatch",
         if not _trace_clean():
             return fn(*args, **kwargs)
         pre = cache_size() if cache_size is not None else -1
+        pre_census = _memledger.census_len()
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         if sync:
@@ -292,11 +302,12 @@ def instrument(fn, name: str, *, kind: str = "dispatch",
         shapes, abytes = _leaf_stats((args, kwargs))
         obytes = _leaf_stats(out)[1] if kind == "readback" else 0
         compiled = (cache_size() > pre) if cache_size is not None else False
+        mem = _memledger.claim_census(pre_census, name)
         seq = led._claim()
         led._write(seq, DispatchRecord(
             seq, name, kind, t0, wall, shapes, abytes, obytes, compiled,
             _trace.current_path(), threading.get_ident(),
-            _trace.get_trace_id()))
+            _trace.get_trace_id(), mem_bytes=mem))
         return out
 
     wrapper.__name__ = f"ledger[{name}]"
@@ -311,7 +322,9 @@ def top_k(k: int = 10, by: str = "wall", ledger: Ledger | None = None,
           records=None, join_costs: bool = True) -> list[dict]:
     """Top-K executables by total wall (`by="wall"`) or call count
     (`by="count"`). Each row: name, count, total_s, mean_s, compiles,
-    arg_bytes, out_bytes — plus the cost-model join (annotated, flops,
+    arg_bytes, out_bytes, mem_bytes/temp_bytes (the name's compile-time
+    footprint ceiling from the memledger census; None when no executable
+    was attributed) — plus the cost-model join (annotated, flops,
     gflops_s, gbytes_s, bound, eff; None when the name carries no
     annotation) unless `join_costs=False`."""
     recs = (ledger if ledger is not None else LEDGER).snapshot() \
@@ -334,6 +347,9 @@ def top_k(k: int = 10, by: str = "wall", ledger: Ledger | None = None,
     for row in rows:
         row["total_s"] = round(row["total_s"], 6)
         row["mean_s"] = round(row["total_s"] / row["count"], 6)
+        fp = _memledger.footprint_for(row["name"])
+        row["mem_bytes"] = fp["total_bytes"] if fp else None
+        row["temp_bytes"] = fp["temp_bytes"] if fp else None
     if join_costs:
         from combblas_tpu.obs import costmodel
         costmodel.join_rows(rows)
@@ -345,13 +361,17 @@ def format_table(k: int = 10, by: str = "wall",
     """Human-readable top-K table (the `--gate`/README surface). The
     `eff` column is the roofline-efficiency fraction from the cost
     model, with the bound class (c/m/i); blank when the name carries
-    no annotation."""
+    no annotation. The `memMB` column is the name's compile-time
+    footprint ceiling (args+outputs+temps of its largest executable,
+    from the memledger census); blank when no executable was
+    attributed (warm cache)."""
     rows = top_k(k, by=by, ledger=ledger)
     led = ledger if ledger is not None else LEDGER
     out = [f"dispatch ledger: {led.total} records "
            f"({led.dropped} wrapped out), top {len(rows)} by {by}:"]
     out.append(f"  {'executable':40s} {'count':>7s} {'total_s':>10s} "
-               f"{'mean_ms':>9s} {'compiles':>8s} {'eff':>8s}")
+               f"{'mean_ms':>9s} {'compiles':>8s} {'eff':>8s} "
+               f"{'memMB':>8s}")
     for r in rows:
         if r.get("eff") is not None:
             eff = f"{r['eff']:.3f}/{r['bound'][0]}"
@@ -359,9 +379,11 @@ def format_table(k: int = 10, by: str = "wall",
             eff = "ann"        # annotated but zero-wall (plan records)
         else:
             eff = ""
+        mem = (f"{r['mem_bytes'] / 1e6:8.1f}"
+               if r.get("mem_bytes") is not None else f"{'':8s}")
         out.append(f"  {r['name'][:40]:40s} {r['count']:7d} "
                    f"{r['total_s']:10.4f} {r['mean_s'] * 1e3:9.3f} "
-                   f"{r['compiles']:8d} {eff:>8s}")
+                   f"{r['compiles']:8d} {eff:>8s} {mem}")
     return "\n".join(out)
 
 

@@ -1,0 +1,595 @@
+"""HBM memory ledger: compile-time footprint census, live-buffer
+watermarks, and the donation audit.
+
+The flight recorder (PR 6) attributes *time* and the roofline ledger
+(PR 10) attributes *FLOPs and bytes per second*; this module closes the
+third roofline axis — memory CAPACITY. Three instruments, all built on
+facts XLA already computed:
+
+* **Compile-time footprint census.** Every XLA compile in the process
+  funnels through one choke point (`jax._src.compiler.
+  compile_or_get_cached`); a one-time wrapper records each loaded
+  executable's `CompiledMemoryStats` (argument / output / temp /
+  generated-code / donation-alias bytes) plus the HLO module name and
+  the compiling thread. `obs.ledger.instrument` wrappers then CLAIM the
+  census entries their call produced (same thread, recorded during the
+  call), so footprints join `DispatchRecord`s, `top_k`, `format_table`,
+  and `dispatch_summary` under the ledger's own executable names —
+  including every PlanCache build, whose plans are instrumented
+  wrappers already. Capturing at the compile hook is free: the stats
+  are a handful of attribute reads next to a multi-second compile.
+* **Live-buffer watermarks.** `jax.live_arrays()` sampled at span
+  close (env-gated cadence, `COMBBLAS_TPU_MEM_WATERMARK=N` = every Nth
+  close) yields per-span HBM watermarks and a monotone peak-resident
+  gauge — the measured side the footprint census predicts.
+* **Donation audit.** Call sites that declare `donate_argnums` register
+  via `declare_donation(name, argnums)`; `audit_donations()` cross-
+  checks each declared name against its compiled executables'
+  `input_output_alias` HLO header (parsed at record time — the
+  executable type is not weakref-able) and the census's
+  `alias_size_in_bytes`, flagging declared-but-unhonored donations
+  with the executable name and arg indices. `min_honored` exists
+  because donation is legitimately partial when output shapes change
+  (mcl.megastep's `new_cap` re-pin): the audit asserts "at least N
+  parameters aliased", not full-leaf coverage.
+
+Analysis pass 6 (`analysis/membudget.py`) gates the resulting
+`memory_summary` artifact blocks against `budgets/memory.json` and the
+`hbm_bytes` field of `utils.config.backend_peaks`.
+
+Everything is lazy about jax: importing this module costs nothing, and
+the census hook installs on the first `ensure_installed()` (which
+`obs.ledger.instrument` calls at wrap time). COMBBLAS_TPU_MEM_CENSUS=0
+disables recording entirely.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import re
+import threading
+import time
+
+#: one `{out_idx}: (param, {param_idx}, kind)` entry in an HLO module
+#: header's `input_output_alias={...}` section; group 1 = param number
+_ALIAS_ENTRY = re.compile(r"\{[\d,\s]*\}\s*:\s*\((\d+)")
+
+#: census stops recording past this many compiles (a process has a few
+#: hundred distinct executables; this is a runaway backstop, surfaced
+#: via `census_dropped`)
+_CENSUS_CAP = 4096
+
+_LOCK = threading.Lock()
+_CENSUS: list = []            # FootprintRecord, append-only until reset
+_CENSUS_DROPPED = 0
+_CENSUS_SEQ = itertools.count()
+_INSTALLED = False
+#: name -> aggregated footprint dict (claimed names survive ledger
+#: wraps and cache clears — once an executable is attributed, its
+#: footprint stays known)
+_BY_NAME: dict = {}
+
+_DONATIONS: dict = {}         # name -> {"argnums": tuple, "min_honored"}
+
+# -- live-buffer watermarks -------------------------------------------------
+_WM_EVERY = int(os.environ.get("COMBBLAS_TPU_MEM_WATERMARK", "0") or 0)
+_WM_TICK = itertools.count()
+_WM_SAMPLES = 0
+_PEAK_RESIDENT = 0
+_SPAN_WM: dict = {}           # span name -> max live bytes at a close
+_WM_SERIES: list = []         # (perf_counter, bytes) samples, bounded
+_WM_SERIES_CAP = 4096
+
+
+def census_enabled() -> bool:
+    return os.environ.get("COMBBLAS_TPU_MEM_CENSUS", "1").lower() \
+        not in ("0", "false")
+
+
+class FootprintRecord:
+    """One compiled executable's memory analysis (immutable except for
+    the ledger-name claim)."""
+
+    __slots__ = ("seq", "module", "name", "tid", "t0", "arg_bytes",
+                 "out_bytes", "temp_bytes", "code_bytes", "alias_bytes",
+                 "alias_params")
+
+    def __init__(self, seq, module, tid, t0, arg_bytes, out_bytes,
+                 temp_bytes, code_bytes, alias_bytes, alias_params=None):
+        self.seq = seq
+        self.module = module          # HLO module name ("jit__place3")
+        self.name = None              # ledger name once claimed
+        self.tid = tid
+        self.t0 = t0
+        self.arg_bytes = arg_bytes
+        self.out_bytes = out_bytes
+        self.temp_bytes = temp_bytes
+        self.code_bytes = code_bytes
+        self.alias_bytes = alias_bytes  # donated bytes XLA aliased
+        self.alias_params = alias_params  # tuple of aliased parameter
+        #                                   numbers from the HLO header
+        #                                   (None: header unparsable)
+
+    @property
+    def total_bytes(self) -> int:
+        """Resident footprint ceiling of one execution: arguments +
+        outputs + temporaries (aliased argument bytes are not double-
+        counted by XLA's output size)."""
+        return self.arg_bytes + self.out_bytes + self.temp_bytes
+
+    def to_dict(self) -> dict:
+        return {"seq": self.seq, "module": self.module, "name": self.name,
+                "arg_bytes": self.arg_bytes, "out_bytes": self.out_bytes,
+                "temp_bytes": self.temp_bytes,
+                "code_bytes": self.code_bytes,
+                "alias_bytes": self.alias_bytes,
+                "alias_params": list(self.alias_params)
+                if self.alias_params is not None else None}
+
+    def __repr__(self):
+        return (f"FootprintRecord(#{self.seq} {self.module!r} "
+                f"name={self.name!r} total={self.total_bytes})")
+
+
+def _record_executable(ex) -> None:
+    """Drop one census record for a freshly compiled executable. Never
+    raises — a census failure must not break a compile."""
+    global _CENSUS_DROPPED
+    try:
+        # re-check at record time: the hook stays installed for the
+        # process lifetime, so the env gate must also silence it live
+        if not census_enabled():
+            return
+        st = ex.get_compiled_memory_stats()
+        module, alias_params = "?", None
+        try:
+            hm = ex.hlo_modules()[0]
+            module = hm.name
+            # the HLO header lists the aliases XLA actually HONORED
+            # (`input_output_alias={ {0}: (0, {}, may-alias) }`) —
+            # LoadedExecutable is not weakref-able, so extract now;
+            # to_string is microseconds next to the compile it follows
+            header = hm.to_string().split("\n", 1)[0]
+            if "input_output_alias" in header:
+                seg = header.split("input_output_alias=", 1)[1]
+                alias_params = tuple(sorted(
+                    {int(m.group(1))
+                     for m in _ALIAS_ENTRY.finditer(seg)}))
+            else:
+                alias_params = ()
+        except Exception:
+            pass
+        rec = FootprintRecord(
+            next(_CENSUS_SEQ), module, threading.get_ident(),
+            time.perf_counter(),
+            int(st.argument_size_in_bytes), int(st.output_size_in_bytes),
+            int(st.temp_size_in_bytes),
+            int(st.generated_code_size_in_bytes),
+            int(st.alias_size_in_bytes), alias_params)
+        with _LOCK:
+            if len(_CENSUS) < _CENSUS_CAP:
+                _CENSUS.append(rec)
+            else:
+                _CENSUS_DROPPED += 1
+    except Exception:
+        pass
+
+
+def ensure_installed() -> bool:
+    """Install the compile-hook once (idempotent). Every XLA compile —
+    jit dispatch misses, AOT `.compile()`, PlanCache builds — funnels
+    through `jax._src.compiler.compile_or_get_cached`; wrapping it is
+    the only way to see ALL executables without re-lowering (an AOT
+    re-lower would be a full second compile). Returns True when the
+    hook is active."""
+    global _INSTALLED
+    if _INSTALLED:
+        return True
+    if not census_enabled():
+        return False
+    with _LOCK:
+        if _INSTALLED:
+            return True
+        try:
+            from jax._src import compiler as _compiler
+        except Exception:      # pragma: no cover - exotic jax
+            return False
+        orig = _compiler.compile_or_get_cached
+
+        def _hooked(*args, **kwargs):
+            ex = orig(*args, **kwargs)
+            _record_executable(ex)
+            return ex
+
+        _hooked.__wrapped__ = orig
+        _compiler.compile_or_get_cached = _hooked
+        _INSTALLED = True
+        return True
+
+
+def census_len() -> int:
+    """Cheap pre-call snapshot for claim bracketing (list len is
+    GIL-atomic)."""
+    return len(_CENSUS)
+
+
+def census_dropped() -> int:
+    return _CENSUS_DROPPED
+
+
+def claim_census(pre_len: int, name: str, tid: int | None = None):
+    """Attribute census entries recorded since ``pre_len`` on the
+    calling thread to ledger name ``name`` (innermost instrumented
+    wrapper wins: nested wrappers claim before their callers see the
+    entries). Returns the summed footprint ceiling of newly claimed
+    executables, or None when nothing was claimed."""
+    if pre_len < 0:
+        return None
+    tid = threading.get_ident() if tid is None else tid
+    total = None
+    with _LOCK:
+        for rec in _CENSUS[pre_len:]:
+            if rec.name is None and rec.tid == tid:
+                rec.name = name
+                agg = _BY_NAME.get(name)
+                if agg is None:
+                    agg = _BY_NAME[name] = {
+                        "name": name, "executables": 0, "modules": [],
+                        "arg_bytes": 0, "out_bytes": 0, "temp_bytes": 0,
+                        "code_bytes": 0, "alias_bytes": 0,
+                        "total_bytes": 0}
+                agg["executables"] += 1
+                if rec.module not in agg["modules"]:
+                    agg["modules"].append(rec.module)
+                # ceilings, not sums: a name compiled at several shapes
+                # costs at most its largest executable per dispatch
+                for k in ("arg_bytes", "out_bytes", "temp_bytes",
+                          "code_bytes", "alias_bytes", "total_bytes"):
+                    agg[k] = max(agg[k], getattr(
+                        rec, k if k != "total_bytes" else "total_bytes"))
+                total = (total or 0) + rec.total_bytes
+    return total
+
+
+def footprint_for(name: str):
+    """Aggregated compile-time footprint for a ledger name:
+    {arg_bytes, out_bytes, temp_bytes, code_bytes, alias_bytes,
+    total_bytes, executables, modules} — per-field MAX across the
+    name's claimed executables (the per-dispatch ceiling) — or None
+    when no executable was ever attributed to the name."""
+    with _LOCK:
+        agg = _BY_NAME.get(name)
+        return dict(agg) if agg else None
+
+
+def census_snapshot() -> list:
+    with _LOCK:
+        return list(_CENSUS)
+
+
+def census_stats() -> dict:
+    with _LOCK:
+        claimed = sum(1 for r in _CENSUS if r.name is not None)
+        return {"executables": len(_CENSUS), "claimed": claimed,
+                "dropped": _CENSUS_DROPPED, "names": len(_BY_NAME)}
+
+
+def census_coverage(ledger=None, records=None) -> dict:
+    """Did the census land where it could have? Over the dispatch-kind
+    names in a ledger, `expected` counts names whose compile happened
+    INSIDE an instrumented wrapper (>=1 record with compiled=True — the
+    only compiles the census can attribute); `covered` counts expected
+    names carrying a footprint. `frac` = covered/expected (1.0 when
+    nothing compiled in-wrapper: a warm cache is not a census failure).
+    The e2e test and the bench `memory_summary` blocks pin frac >= 0.9
+    on cold phased-SpGEMM runs, where every dispatched executable
+    compiles in-wrapper."""
+    if records is None:
+        from combblas_tpu.obs import ledger as _ledger
+        records = (ledger if ledger is not None
+                   else _ledger.LEDGER).snapshot()
+    names = set()
+    expected = set()
+    for r in records:
+        if r.kind != "dispatch":
+            continue
+        names.add(r.name)
+        if r.compiled:
+            expected.add(r.name)
+    with _LOCK:
+        covered = {n for n in expected if n in _BY_NAME}
+        known = {n for n in names if n in _BY_NAME}
+    return {"names": len(names), "expected": len(expected),
+            "covered": len(covered), "with_footprint": len(known),
+            "frac": round(len(covered) / len(expected), 4)
+            if expected else 1.0}
+
+
+# ---------------------------------------------------------------------------
+# donation audit
+# ---------------------------------------------------------------------------
+
+def declare_donation(name: str, argnums, min_honored: int = 1,
+                     waiver: str | None = None) -> None:
+    """Register that the executable behind ledger name ``name`` is
+    built with ``donate_argnums=argnums``. The audit then requires at
+    least ``min_honored`` aliased parameters on every compiled
+    executable attributed to the name (default 1: shape-changing paths
+    like megastep's re-pin legally alias only part of the donation).
+
+    ``waiver`` documents a site where XLA provably CANNOT alias (e.g.
+    capacity grow/shrink: output bytes differ from input bytes, yet the
+    donation still invalidates the input eagerly, which is the point).
+    A waived site that fails ``min_honored`` reports status "waived"
+    with the reason, not "unhonored" — declared, explained, visible."""
+    with _LOCK:
+        _DONATIONS[name] = {"argnums": tuple(argnums),
+                            "min_honored": int(min_honored),
+                            "waiver": waiver}
+
+
+def declared_donations() -> dict:
+    with _LOCK:
+        return {k: dict(v) for k, v in _DONATIONS.items()}
+
+
+def audit_donations(names=None) -> list:
+    """Cross-check every declared donation against its compiled
+    executables. One row per declared name:
+
+        {name, argnums, min_honored, executables, honored_params,
+         alias_bytes, status, ok}
+
+    status: "honored" (>= min_honored aliased parameters on every
+    attributed executable), "unhonored" (an executable aliased fewer —
+    the declaration is a lie XLA silently ignored, the buffer is NOT
+    released), "waived" (aliased fewer, but the declaration carries a
+    documented waiver — ok=True), "unobserved" (no executable
+    attributed yet — ok=None, not a failure: the site never dispatched
+    this run)."""
+    with _LOCK:
+        decls = {k: dict(v) for k, v in _DONATIONS.items()
+                 if names is None or k in names}
+        by_name: dict = {}
+        for rec in _CENSUS:
+            if rec.name in decls:
+                by_name.setdefault(rec.name, []).append(rec)
+    out = []
+    for name in sorted(decls):
+        d = decls[name]
+        recs = by_name.get(name, [])
+        row = {"name": name, "argnums": list(d["argnums"]),
+               "min_honored": d["min_honored"],
+               "executables": len(recs), "honored_params": [],
+               "alias_bytes": 0}
+        if not recs:
+            row["status"], row["ok"] = "unobserved", None
+            out.append(row)
+            continue
+        ok = True
+        honored: set = set()
+        for rec in recs:
+            if rec.alias_params is None:
+                # header unparsable: the census's alias byte count
+                # still tells us whether ANY donation was honored
+                n = 1 if rec.alias_bytes > 0 else 0
+            else:
+                honored |= set(rec.alias_params)
+                n = len(rec.alias_params)
+            row["alias_bytes"] = max(row["alias_bytes"], rec.alias_bytes)
+            if n < d["min_honored"]:
+                ok = False
+        row["honored_params"] = sorted(honored)
+        if ok:
+            row["status"], row["ok"] = "honored", True
+        elif d.get("waiver"):
+            row["status"], row["ok"] = "waived", True
+            row["waiver"] = d["waiver"]
+        else:
+            row["status"], row["ok"] = "unhonored", False
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live-buffer watermarks
+# ---------------------------------------------------------------------------
+
+def sample_live_bytes():
+    """Total bytes of live committed jax Arrays, or None when jax is
+    unavailable. One pass over `jax.live_arrays()` — cheap attribute
+    reads, no device syncs."""
+    try:
+        import jax
+        return sum(int(getattr(a, "nbytes", 0) or 0)
+                   for a in jax.live_arrays())
+    except Exception:
+        return None
+
+
+def set_watermark_cadence(every: int) -> None:
+    """Sample live bytes at every Nth span close (0 = off). Installs
+    the span-close hook on first arm."""
+    global _WM_EVERY
+    _WM_EVERY = max(int(every), 0)
+    if _WM_EVERY > 0:
+        from combblas_tpu.obs import trace as _trace
+        _trace.set_span_close_hook(_on_span_close)
+
+
+def watermark_cadence() -> int:
+    return _WM_EVERY
+
+
+def _on_span_close(rec) -> None:
+    """trace.Tracer hook: sample at the configured cadence and fold
+    into the per-span watermark + peak gauge. Monotone-safe under
+    concurrent spans: all folds are max-updates under one lock."""
+    every = _WM_EVERY
+    if every <= 0:
+        return
+    if next(_WM_TICK) % every:
+        return
+    note_live_sample(span=rec.name)
+
+
+def note_live_sample(span: str | None = None):
+    """Take one live-buffer sample NOW and fold it into the peak gauge
+    (and the span watermark when ``span`` is given). Returns the sample
+    bytes (None when unavailable). Bench harnesses call this at their
+    high-water moments even when cadence sampling is off."""
+    global _PEAK_RESIDENT, _WM_SAMPLES
+    b = sample_live_bytes()
+    if b is None:
+        return None
+    now = time.perf_counter()
+    with _LOCK:
+        _WM_SAMPLES += 1
+        if b > _PEAK_RESIDENT:
+            _PEAK_RESIDENT = b
+        if span is not None and b > _SPAN_WM.get(span, -1):
+            _SPAN_WM[span] = b
+        if len(_WM_SERIES) < _WM_SERIES_CAP:
+            _WM_SERIES.append((now, b))
+    return b
+
+
+def peak_resident_bytes() -> int:
+    return _PEAK_RESIDENT
+
+
+def watermark_samples() -> int:
+    return _WM_SAMPLES
+
+
+def span_watermarks() -> dict:
+    """span name -> max live bytes observed at one of its closes."""
+    with _LOCK:
+        return dict(_SPAN_WM)
+
+
+def watermark_series() -> list:
+    """Time-ordered (perf_counter, live_bytes) samples (bounded at
+    4096; timeline.resident_watermark windows over these)."""
+    with _LOCK:
+        return list(_WM_SERIES)
+
+
+# ---------------------------------------------------------------------------
+# capacity verdict + summary block
+# ---------------------------------------------------------------------------
+
+def hbm_bytes(peaks=None) -> float:
+    if peaks is None:
+        from combblas_tpu.utils.config import backend_peaks
+        peaks = backend_peaks()
+    return float(peaks.hbm_bytes)
+
+
+def headroom(peaks=None) -> dict:
+    """{hbm_bytes, peak_resident_bytes, largest_footprint_bytes,
+    headroom_frac}: the fraction of capacity NOT spoken for by the
+    worst of (measured peak, largest single-executable footprint)."""
+    cap = hbm_bytes(peaks)
+    with _LOCK:
+        largest = max((a["total_bytes"] for a in _BY_NAME.values()),
+                      default=0)
+    worst = max(_PEAK_RESIDENT, largest)
+    return {"hbm_bytes": cap, "peak_resident_bytes": _PEAK_RESIDENT,
+            "largest_footprint_bytes": largest,
+            "headroom_frac": round(max(1.0 - worst / cap, 0.0), 4)
+            if cap > 0 else None}
+
+
+def configured_headroom_frac() -> float:
+    """COMBBLAS_TPU_MEM_HEADROOM (default 0.8): the fraction of
+    `backend_peaks().hbm_bytes` a single plan's implied working set may
+    claim before a planner emits `obs.mem_headroom_warn`. Read per
+    call so tests can flip it without re-importing."""
+    try:
+        return float(os.environ.get("COMBBLAS_TPU_MEM_HEADROOM", "0.8"))
+    except ValueError:
+        return 0.8
+
+
+def warn_working_set(working_set_bytes: int, kind: str) -> bool:
+    """Planner-side OOM-risk check: compare an implied working set
+    against `hbm_bytes * configured_headroom_frac()`; when it does not
+    fit, bump the `obs.mem_headroom_warn` counter (labeled by ``kind``)
+    and record the offending estimate on a gauge. Returns True when
+    the warning fired. This is the cheap PLAN-time signal; the
+    membudget gate and the live watermarks confirm at run time."""
+    budget = hbm_bytes() * configured_headroom_frac()
+    if working_set_bytes <= budget:
+        return False
+    from combblas_tpu.obs import metrics as _metrics
+    _metrics.counter(
+        "obs.mem_headroom_warn",
+        "plans whose implied working set exceeded the configured "
+        "fraction of the backend's HBM capacity").inc(kind=kind)
+    _metrics.gauge(
+        "obs.mem_working_set_bytes",
+        "last working-set estimate that tripped the headroom warning"
+    ).set(int(working_set_bytes), kind=kind)
+    return True
+
+
+def top_footprints(k: int = 8) -> list:
+    """Top-K claimed names by temp-byte ceiling (the budget pass's
+    per-executable currency)."""
+    with _LOCK:
+        rows = [dict(a) for a in _BY_NAME.values()]
+    rows.sort(key=lambda a: a["temp_bytes"], reverse=True)
+    return rows[:max(k, 0)]
+
+
+def summary(ledger=None, k: int = 8, full: bool = True) -> dict:
+    """The `memory_summary` block bench artifacts embed next to
+    `dispatch_summary` (and pass 6 gates): capacity verdict, census
+    coverage, top footprints, and (full=True) the donation audit. Takes
+    one fresh live-buffer sample so `peak_resident_bytes` is never
+    vacuously zero when cadence sampling is off."""
+    note_live_sample()
+    out = {
+        **headroom(),
+        "watermark_samples": _WM_SAMPLES,
+        "census": census_stats(),
+        "census_coverage": census_coverage(ledger=ledger),
+        "top": top_footprints(k),
+    }
+    if full:
+        audit = audit_donations()
+        out["donation_audit"] = {
+            "declared": len(audit),
+            "unhonored": [r["name"] for r in audit if r["ok"] is False],
+            "waived": [r["name"] for r in audit
+                       if r["status"] == "waived"],
+            "unobserved": [r["name"] for r in audit if r["ok"] is None],
+            "entries": audit,
+        }
+    return out
+
+
+def reset(donations: bool = False) -> None:
+    """Clear the census, attributions, and watermarks (tests). The
+    donation REGISTRY survives by default — declarations happen at
+    import time and don't recur."""
+    global _CENSUS_DROPPED, _PEAK_RESIDENT, _WM_SAMPLES
+    with _LOCK:
+        _CENSUS.clear()
+        _BY_NAME.clear()
+        _SPAN_WM.clear()
+        _WM_SERIES.clear()
+        _CENSUS_DROPPED = 0
+        _PEAK_RESIDENT = 0
+        _WM_SAMPLES = 0
+        if donations:
+            _DONATIONS.clear()
+
+
+# env-armed cadence must also install the span-close hook — without
+# this, COMBBLAS_TPU_MEM_WATERMARK set before import arms the counter
+# but never samples
+if _WM_EVERY > 0:
+    set_watermark_cadence(_WM_EVERY)

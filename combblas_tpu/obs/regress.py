@@ -12,10 +12,13 @@ of those shapes:
 
 * `normalize_artifact(name, doc)` — one canonical run row per
   artifact (run id, workload, scale, backend, wall, headline value,
-  dispatch/compile counts, exchanged bytes, efficiency) with an
+  dispatch/compile counts, exchanged bytes, efficiency, peak resident
+  bytes + census coverage from the `memory_summary` block) with an
   explicit `schema` grade: "full" (dispatch_summary AND
   unaccounted_s), "partial" (summary only), "legacy" (pre-PR-6 —
-  flagged, never crashed on);
+  flagged, never crashed on) — plus an independent `mem_schema` grade
+  for the memory block (None on pre-memledger artifacts: legacy
+  artifacts keep their grade, nothing is retroactively rejected);
 * `build_trajectory(root)` — the committed `BENCH_TRAJECTORY.json`
   (`scripts/bench_registry.py` is the CLI);
 * `validate_run(run)` / `validate_artifact(doc)` — the schema gate:
@@ -50,11 +53,18 @@ ARTIFACT_GLOBS = (
 RUN_FIELDS = ("run_id", "artifact", "workload", "seq", "scale",
               "backend", "wall_s", "value", "unit", "dispatches",
               "compiles", "exchanged_bytes", "efficiency",
-              "attributable_frac", "unaccounted_s", "schema")
+              "attributable_frac", "unaccounted_s", "schema",
+              "peak_resident_bytes", "mem_census_frac", "mem_schema")
 
 _REQUIRED = ("run_id", "artifact", "workload", "schema")
 
 _SCHEMAS = ("full", "partial", "legacy")
+
+#: memory-block grades: "full" = memory_summary with census coverage
+#: AND donation audit; "partial" = a memory_summary missing one of
+#: those; None = recorded before the memory ledger existed (legacy —
+#: flagged, never crashed on, and the row keeps its `schema` grade)
+_MEM_SCHEMAS = ("full", "partial", None)
 
 
 class SchemaError(ValueError):
@@ -84,6 +94,53 @@ def _collect_summaries(doc):
 
     walk(doc)
     return out
+
+
+def _collect_memory_summaries(doc):
+    """Every memory_summary block, wherever nested (same walk as
+    dispatch_summary: serve artifacts keep one per mode)."""
+    out = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            ms = node.get("memory_summary")
+            if isinstance(ms, dict):
+                out.append(ms)
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, list):
+            for v in node:
+                walk(v)
+
+    walk(doc)
+    return out
+
+
+def _memory_of(doc):
+    """(peak_resident_bytes, mem_census_frac, mem_schema) from the
+    artifact's memory_summary blocks. Peak is the worst of measured
+    live-buffer peak and largest single-executable footprint across
+    blocks; census frac is the WORST coverage (the gate's view).
+    Legacy artifacts (no block) grade None — kept, never rejected."""
+    blocks = _collect_memory_summaries(doc)
+    if not blocks:
+        return None, None, None
+    peak = 0
+    fracs = []
+    full = True
+    for ms in blocks:
+        peak = max(peak,
+                   int(_num(ms.get("peak_resident_bytes")) or 0),
+                   int(_num(ms.get("largest_footprint_bytes")) or 0))
+        cc = ms.get("census_coverage")
+        if isinstance(cc, dict) and _num(cc.get("frac")) is not None:
+            fracs.append(float(cc["frac"]))
+        else:
+            full = False
+        if not isinstance(ms.get("donation_audit"), dict):
+            full = False
+    frac = round(min(fracs), 4) if fracs else None
+    return peak, frac, ("full" if full and fracs else "partial")
 
 
 def _find_key(doc, key):
@@ -272,6 +329,7 @@ def normalize_artifact(name: str, doc) -> dict:
     compiles = sum(int(s.get("compiles", 0) or 0)
                    for s in summaries) if summaries else None
     eff, frac = _efficiency_of(summaries)
+    peak_b, mem_frac, mem_schema = _memory_of(doc)
     stem = pathlib.PurePath(name).name[:-len(".json")] \
         if name.endswith(".json") else pathlib.PurePath(name).name
     row = {
@@ -291,6 +349,9 @@ def normalize_artifact(name: str, doc) -> dict:
         "attributable_frac": frac,
         "unaccounted_s": _num(_find_key(doc, "unaccounted_s")),
         "schema": schema,
+        "peak_resident_bytes": peak_b,
+        "mem_census_frac": mem_frac,
+        "mem_schema": mem_schema,
     }
     validate_run(row)
     return row
@@ -307,12 +368,16 @@ def validate_run(run: dict) -> None:
     if run["schema"] not in _SCHEMAS:
         raise SchemaError(f"{run['run_id']}: unknown schema grade "
                           f"{run['schema']!r}")
+    if run.get("mem_schema") not in _MEM_SCHEMAS:
+        raise SchemaError(f"{run['run_id']}: unknown memory-schema "
+                          f"grade {run['mem_schema']!r}")
     unknown = set(run) - set(RUN_FIELDS)
     if unknown:
         raise SchemaError(f"{run['run_id']}: unknown fields "
                           f"{sorted(unknown)}")
     for k in ("wall_s", "value", "efficiency", "attributable_frac",
-              "unaccounted_s"):
+              "unaccounted_s", "peak_resident_bytes",
+              "mem_census_frac"):
         v = run.get(k)
         if v is not None and _num(v) is None:
             raise SchemaError(f"{run['run_id']}: field {k} not numeric: "

@@ -172,6 +172,26 @@ def deferred_readback_stats(records=None, ledger=None) -> dict:
     return out
 
 
+# -------------------------------------------------- resident memory
+
+def resident_watermark(t0: float | None = None,
+                       t1: float | None = None) -> dict:
+    """Peak/mean live-buffer bytes over a perf_counter window, from the
+    memledger's watermark sample series (same clock as span and ledger
+    records, so `resident_watermark(span.t0, span.t1)` prices a span's
+    residency). None bounds are open. {samples, peak_bytes, mean_bytes}
+    — zeros when no sample landed in the window (cadence off or window
+    too narrow), never a guess."""
+    from combblas_tpu.obs import memledger as _memledger
+    pts = [(t, b) for t, b in _memledger.watermark_series()
+           if (t0 is None or t >= t0) and (t1 is None or t <= t1)]
+    if not pts:
+        return {"samples": 0, "peak_bytes": 0, "mean_bytes": 0}
+    vals = [b for _, b in pts]
+    return {"samples": len(vals), "peak_bytes": max(vals),
+            "mean_bytes": int(sum(vals) / len(vals))}
+
+
 # ------------------------------------------------- unaccounted split
 
 def split_unaccounted(tracer=None, ledger=None) -> dict:

@@ -121,6 +121,12 @@ class Tracer:
                 self.records.append(rec)
             else:
                 self.dropped += 1
+        hook = _SPAN_CLOSE_HOOK          # module global: set after class def
+        if hook is not None:
+            try:
+                hook(rec)
+            except Exception:            # an observer must not break spans
+                pass
 
     def reset(self) -> None:
         """Drop completed records (open spans are unaffected — their
@@ -135,6 +141,19 @@ class Tracer:
 
 
 TRACER = Tracer()
+
+#: optional observer invoked with each completed SpanRecord (outside
+#: the tracer lock). Sole current client: memledger's live-buffer
+#: watermark sampler. One slot, not a list — keep the close path flat.
+_SPAN_CLOSE_HOOK = None
+
+
+def set_span_close_hook(fn) -> None:
+    """Install (or clear, with None) the span-close observer. The hook
+    must never raise and should be cheap relative to a span close; it
+    runs on the closing thread after the record lands."""
+    global _SPAN_CLOSE_HOOK
+    _SPAN_CLOSE_HOOK = fn
 
 
 class _NoopSpan:

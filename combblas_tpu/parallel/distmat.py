@@ -227,10 +227,29 @@ def _merge_chunk(add: Monoid, grid: ProcGrid, acc_r, acc_c, acc_v, acc_n,
             full.reshape(pr, pc))
 
 
-#: per-band sort budget for the chunked builder: merges above this
-#: slot count compile sort programs whose buffers exceed HBM (the
-#: scale-24 single-band merge crashed the TPU compile helper)
-_BAND_SLOTS = 1 << 26
+#: sort working set per COO slot during a band merge: the i64 fused
+#: key + i32 row/col/val copies a bitonic-sort program keeps live at
+#: once, empirically ~240 B. 16 GB HBM / 240 B reproduces the 1 << 26
+#: per-band budget that survived the scale-24 build (a single-band
+#: merge above it crashed the TPU compile helper).
+_BAND_SLOT_BYTES = 240
+
+
+def _band_slots() -> int:
+    """Per-band sort budget for the chunked builder, derived from the
+    backend's memory capacity (`backend_peaks().hbm_bytes`, so
+    COMBBLAS_TPU_PEAKS recalibrates it without a code change) instead
+    of the old hard-coded 1 << 26: largest power of two whose sort
+    working set fits the chip, floored at 1 << 20. On a 16 GB TPU this
+    lands exactly on the empirically safe 1 << 26."""
+    try:
+        from combblas_tpu.utils.config import backend_peaks
+        n = int(float(backend_peaks().hbm_bytes) // _BAND_SLOT_BYTES)
+    except Exception:       # peaks unavailable: the proven default
+        return 1 << 26
+    if n <= 0:
+        return 1 << 20
+    return max(1 << 20, 1 << (n.bit_length() - 1))
 
 
 def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
@@ -257,7 +276,8 @@ def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
     ascending dynamic_update_slice writes (each band's garbage tail is
     overwritten by the next band's live prefix) — no global sort ever
     runs, which is what lets a scale-24 matrix (~0.5G entries) build
-    on one 16 GB chip. Default: auto from the capacity estimate.
+    on one chip of `backend_peaks().hbm_bytes` capacity (16 GB on a
+    v5e). Default: auto from the capacity estimate via `_band_slots`.
     """
     pr, pc = grid.pr, grid.pc
     tile_m = _ceil_div(nrows, pr)
@@ -267,8 +287,15 @@ def from_coo_chunks(add: Monoid, grid: ProcGrid, chunk_fn, nchunks: int,
         cap = max(1024, _ceil_div(est, pr * pc))
     cap = -(-cap // 128) * 128
     if row_bands is None:
-        row_bands = max(1, _ceil_div(cap, _BAND_SLOTS))
+        row_bands = max(1, _ceil_div(cap, _band_slots()))
     row_bands = min(row_bands, tile_m)
+    # OOM-risk signal at build time: the band loop holds old + new
+    # accumulators for ONE band plus the replicated chunk; warn when
+    # even that bounded working set crowds the configured headroom
+    # fraction of `backend_peaks().hbm_bytes`
+    from combblas_tpu.obs import memledger as _memledger
+    _memledger.warn_working_set(
+        2 * _ceil_div(cap, row_bands) * 12, "from_coo")
     band_m = _ceil_div(tile_m, row_bands)
     bands = [(b * band_m, min((b + 1) * band_m, tile_m))
              for b in range(row_bands)]

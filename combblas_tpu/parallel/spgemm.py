@@ -842,7 +842,8 @@ def _shrink_tile(t: tl.Tile, *, new_cap: int) -> tl.Tile:
     """Donated capacity change: the window result's flops-sized buffers
     are released the moment the live prefix is copied out, instead of
     surviving until Python drops the reference — the difference between
-    fitting and OOMing two in-flight windows under the 16 GB ceiling."""
+    fitting and OOMing two in-flight windows under the backend's HBM
+    ceiling (`backend_peaks().hbm_bytes`, 16 GB on a v5e-class chip)."""
     return t.with_capacity(new_cap)
 
 
@@ -934,6 +935,28 @@ _place3 = obs.instrument(_place3, "spgemm.place3")
 _shrink_tile = obs.instrument(_shrink_tile, "spgemm.shrink_tile")
 _shrink_place3 = obs.instrument(_shrink_place3, "spgemm.shrink_place3")
 _grow3 = obs.instrument(_grow3, "spgemm.grow3")
+
+# donation audit registrations: each helper above declares
+# donate_argnums, and the working-set math in the docstrings assumes
+# XLA actually honors them (an unhonored donation keeps BOTH copies
+# live — exactly the silent 2x the audit exists to catch). place3's
+# accumulator carries must alias (same shape in and out);
+# shrink_place3 aliases the 3 accumulator params while its sliced
+# window params (4, 5, 6) legally cannot. The capacity movers change
+# buffer SIZES, so XLA provably cannot alias them — waived: the
+# donation still invalidates the oversized input eagerly, which is
+# what keeps two in-flight windows under the HBM ceiling.
+_CAP_MOVE_WAIVER = ("capacity change: output bytes != input bytes, "
+                    "aliasing impossible; donation still frees the "
+                    "input at dispatch")
+obs.memledger.declare_donation("spgemm.place3", (0, 1, 2),
+                               min_honored=3)
+obs.memledger.declare_donation("spgemm.shrink_place3",
+                               (0, 1, 2, 4, 5, 6), min_honored=3)
+obs.memledger.declare_donation("spgemm.shrink_tile", (0,),
+                               waiver=_CAP_MOVE_WAIVER)
+obs.memledger.declare_donation("spgemm.grow3", (0, 1, 2),
+                               waiver=_CAP_MOVE_WAIVER)
 
 
 def _ledger_name(variant: str) -> str:
@@ -1140,6 +1163,17 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             _M_VARIANT.inc(kind=v)
             _M_DENSITY.observe(w.density)
         _annotate_window_costs(windows, variants, at, win_width)
+        # OOM-risk check against the peaks table's hbm_bytes (not the
+        # old hard-coded 16 GB): the async pipeline keeps two windows
+        # in flight at their flops-sized caps, plus the accumulator at
+        # the summed out-caps. A warning here, at PLAN time, is the
+        # cheap early signal the membudget gate and the watermarks
+        # confirm at run time.
+        if windows:
+            max_fc = max(int(w.flops_cap) for w in windows)
+            acc_cap = sum(int(w.out_cap) for w in windows)
+            obs.memledger.warn_working_set(
+                (2 * max_fc + acc_cap) * _SLOT_B, "spgemm_windows")
 
     def wrap(t: tl.Tile) -> DistSpMat:
         return DistSpMat(t.rows[None, None], t.cols[None, None],
@@ -1186,9 +1220,11 @@ def _windows_sync(sr, a, b, at, bt, windows, win_width, b_struct,
                     obs.sync(cp.rows)
             # shrink to the true output size: out_cap above is flops-
             # bounded (~2-4x the deduped nnz on power-law graphs), and
-            # holding the flops-sized buffer OOMs the 16 GB HBM at
-            # scale >= 16. One scalar readback per phase buys a bounded
-            # working set — and makes the placement offsets host-known.
+            # holding the flops-sized buffer OOMs the backend's HBM
+            # capacity (`backend_peaks().hbm_bytes` — 16 GB on a
+            # v5e-class chip) at scale >= 16. One scalar readback per
+            # phase buys a bounded working set — and makes the
+            # placement offsets host-known.
             with obs.span("nnz_readback", category="host_readback"), \
                     obs.ledger.readback("spgemm.nnz_readback", 4):
                 pn = int(np.asarray(cp.nnz))

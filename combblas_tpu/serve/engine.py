@@ -85,6 +85,13 @@ _efficiency = obs.gauge(
     "serve.efficiency",
     "wall-weighted roofline efficiency of this kind's dispatches "
     "(obs.costmodel join over serve.* ledger names)")
+_mem_headroom = obs.gauge(
+    "serve.memory_headroom",
+    "fraction of backend HBM not accounted for by the larger of "
+    "peak live-buffer bytes and the largest compiled footprint")
+_plan_bytes = obs.gauge(
+    "serve.plan_cache_bytes",
+    "compile-time HBM bytes of cached plan executables, by kind")
 
 
 @dataclasses.dataclass
@@ -241,6 +248,12 @@ class GraphService:
                 "kinds": self._slo_snapshot(),
             },
             "efficiency": obs.costmodel.efficiency_by(self._serve_kind),
+            # byte-level plan accounting: what each cached executable
+            # costs in HBM (compile-time census join) plus the service
+            # headroom verdict — the numbers a byte-aware LRU or a
+            # multi-tenant packer would charge against
+            "plan_memory": self.plans.memory_stats(),
+            "memory_headroom": obs.memledger.headroom(),
         }
 
     # ------------------------------------------------------------------
@@ -289,6 +302,11 @@ class GraphService:
         for kind, eff in obs.costmodel.efficiency_by(
                 self._serve_kind).items():
             _efficiency.set(eff, kind=kind)
+        hr = obs.memledger.headroom()
+        if hr["headroom_frac"] is not None:
+            _mem_headroom.set(hr["headroom_frac"])
+        for kind, nbytes in self.plans.memory_stats()["by_kind"].items():
+            _plan_bytes.set(nbytes, kind=kind)
 
     def _fail_pending(self) -> None:
         for r in self.queue.drain():

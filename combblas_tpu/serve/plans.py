@@ -95,3 +95,34 @@ class PlanCache:
         with self._lock:
             return {f"{k.kind}/w{k.bucket}": e.hits
                     for k, e in sorted(self._plans.items())}
+
+    def memory_stats(self) -> dict:
+        """Per-plan compile-time HBM byte accounting, joined from the
+        memledger's footprint census by the plan's ledger name. Returns
+        {plans: {name: {arg,out,temp,total}_bytes}, by_kind:
+        {kind: total_bytes}, total_bytes, temp_bytes, plans_with_footprint}.
+        Plans whose executables never landed in the census (census off,
+        or the plan wraps host-side work that never hit XLA) are simply
+        absent from `plans` — the substrate a byte-aware eviction policy
+        (multi-tenant LRU) charges per entry."""
+        from combblas_tpu.obs import memledger as _memledger
+        with self._lock:
+            keys = list(self._plans)
+        plans: dict = {}
+        by_kind: dict = {}
+        total = temp = 0
+        for k in sorted(keys):
+            fp = _memledger.footprint_for(_plan_name(k))
+            if fp is None:
+                continue
+            row = {"arg_bytes": fp["arg_bytes"],
+                   "out_bytes": fp["out_bytes"],
+                   "temp_bytes": fp["temp_bytes"],
+                   "total_bytes": fp["total_bytes"]}
+            plans[_plan_name(k)] = row
+            by_kind[k.kind] = by_kind.get(k.kind, 0) + row["total_bytes"]
+            total += row["total_bytes"]
+            temp += row["temp_bytes"]
+        return {"plans": plans, "by_kind": by_kind,
+                "total_bytes": total, "temp_bytes": temp,
+                "plans_with_footprint": len(plans)}

@@ -37,15 +37,19 @@ class BackendPeaks:
     flops_per_s: float      # sustained f32 FLOP/s (MXU for TPU)
     mem_bytes_per_s: float  # HBM / main-memory stream bandwidth
     ici_bytes_per_s: float  # per-link interconnect bandwidth
+    hbm_bytes: float = 1.6e10  # per-chip memory CAPACITY (the third
+    #                            roofline axis: footprints and live-
+    #                            buffer watermarks gate against this)
 
 
 #: name -> peaks. "cpu" models the single-process XLA:CPU backend the
-#: tests/benches run on (a few vectorized cores); "tpu" models a
-#: v5e-class chip (f32 MXU ~49 TFLOP/s, 819 GB/s HBM, ~160 GB/s ICI
-#: per link). Unknown platforms fall back to "cpu".
+#: tests/benches run on (a few vectorized cores, host-RAM capacity);
+#: "tpu" models a v5e-class chip (f32 MXU ~49 TFLOP/s, 819 GB/s HBM,
+#: ~160 GB/s ICI per link, 16 GB HBM). Unknown platforms fall back to
+#: "cpu".
 PEAKS = {
-    "cpu": BackendPeaks("cpu", 5.0e10, 2.0e10, 1.0e10),
-    "tpu": BackendPeaks("tpu", 4.9e13, 8.2e11, 1.6e11),
+    "cpu": BackendPeaks("cpu", 5.0e10, 2.0e10, 1.0e10, 6.4e10),
+    "tpu": BackendPeaks("tpu", 4.9e13, 8.2e11, 1.6e11, 1.6e10),
 }
 
 
@@ -72,7 +76,7 @@ def backend_peaks(platform: Optional[str] = None) -> BackendPeaks:
             base = dataclasses.replace(
                 base, **{k: float(v) for k, v in override.items()
                          if k in ("flops_per_s", "mem_bytes_per_s",
-                                  "ici_bytes_per_s")})
+                                  "ici_bytes_per_s", "hbm_bytes")})
         except (ValueError, TypeError):
             pass                    # malformed override: keep the table
     return base

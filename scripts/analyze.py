@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis gate: one command, five passes, one verdict.
+"""Static-analysis gate: one command, six passes, one verdict.
 
     PYTHONPATH=/root/repo python scripts/analyze.py --gate
 
@@ -18,6 +18,10 @@ code):
             BENCH_TRAJECTORY.json coverage, roofline-efficiency
             floors, newest-vs-baseline noise bands
             (perf_regression.json)
+  mem       memory-budget gate over bench memory_summary blocks:
+            XLA temp-scratch ceilings, peak-footprint fraction of
+            hbm_bytes, census-coverage floors, donation contract
+            (memory.json)
 
 Exit status: 0 iff no unsuppressed finding (the CI gate contract —
 `pytest -m quick` runs the same passes via tests/test_analysis.py).
@@ -84,6 +88,10 @@ def run_passes(passes, entry=None):
         t0 = time.time()
         findings += analysis.run_perf()
         timings["perf"] = time.time() - t0
+    if "mem" in passes and entry is None:
+        t0 = time.time()
+        findings += analysis.run_mem()
+        timings["mem"] = time.time() - t0
     return findings, timings
 
 
@@ -194,6 +202,32 @@ def self_test() -> int:
     else:
         print("  [ok] bad_perf_budget.json: missing trajectory flagged")
 
+    print("fixture: bad_memory_budget.json")
+    from combblas_tpu.analysis import membudget
+    fs = membudget.run_mem(files=[fx / "bad_memory_budget.json"],
+                           root=fx)
+    expect("memory budget overshoot", {f.rule for f in fs},
+           core.MEM_TEMP, core.MEM_PEAK, core.MEM_DONATION,
+           core.MEM_CENSUS, core.MEM_STALE)
+    # the waived entry must be suppressed: exactly ONE temp-ceiling
+    # finding survives (the unwaived one), not two
+    temps = [f for f in fs if f.rule == core.MEM_TEMP]
+    if len(temps) != 1:
+        failures.append(f"bad_memory_budget.json: expected exactly 1 "
+                        f"surviving temp-ceiling finding (the waived "
+                        f"entry suppressed), got {len(temps)}")
+    else:
+        print("  [ok] bad_memory_budget.json: allow-list honored")
+    # resolved against the repo root the fixture artifact is absent:
+    # the missing-artifact arm of mem-stale-artifact must fire
+    missing = membudget.run_mem(files=[fx / "bad_memory_budget.json"])
+    if not any(f.rule == core.MEM_STALE and "not found" in f.message
+               for f in missing):
+        failures.append("bad_memory_budget.json: missing artifact did "
+                        "not flag mem-stale-artifact")
+    else:
+        print("  [ok] bad_memory_budget.json: missing artifact flagged")
+
     for fname, rule in [("bad_lock_cycle.py", core.LOCK_CYCLE),
                         ("bad_jit_under_lock.py", core.JIT_UNDER_LOCK),
                         ("bad_bare_acquire.py", core.BARE_ACQUIRE)]:
@@ -229,8 +263,10 @@ def main() -> int:
                          "bad-pattern fixtures")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings")
-    ap.add_argument("--passes", default="budgets,retrace,locks,obs,perf",
-                    help="comma list of budgets,retrace,locks,obs,perf")
+    ap.add_argument("--passes",
+                    default="budgets,retrace,locks,obs,perf,mem",
+                    help="comma list of budgets,retrace,locks,obs,"
+                         "perf,mem")
     ap.add_argument("--entry", default=None,
                     help="restrict the budget pass to one entry point")
     args = ap.parse_args()
@@ -240,7 +276,8 @@ def main() -> int:
         return self_test()
 
     passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
-    bad = set(passes) - {"budgets", "retrace", "locks", "obs", "perf"}
+    bad = set(passes) - {"budgets", "retrace", "locks", "obs", "perf",
+                         "mem"}
     if bad:
         ap.error(f"unknown pass(es): {sorted(bad)}")
     findings, timings = run_passes(passes, entry=args.entry)

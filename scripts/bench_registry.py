@@ -74,8 +74,10 @@ def cmd_build(args) -> int:
     TRAJECTORY.write_text(text)
     legacy = sum(r["schema"] == "legacy" for r in traj["runs"])
     partial = sum(r["schema"] == "partial" for r in traj["runs"])
+    with_mem = sum(r.get("mem_schema") is not None for r in traj["runs"])
     print(f"wrote {TRAJECTORY.name}: {len(traj['runs'])} runs "
-          f"({legacy} legacy, {partial} partial)")
+          f"({legacy} legacy, {partial} partial, {with_mem} with "
+          "memory_summary)")
     _emit(traj, args.json)
     return 0
 
@@ -105,8 +107,9 @@ def cmd_check(args) -> int:
         print(f"FAIL: [{v['workload']}/{v['metric']}] {v['message']}")
     if violations:
         return 1
-    print(f"OK: {run['run_id']} (schema {run['schema']}) within the "
-          "noise bands of the committed trajectory")
+    mem = run.get("mem_schema") or "absent"
+    print(f"OK: {run['run_id']} (schema {run['schema']}, memory "
+          f"{mem}) within the noise bands of the committed trajectory")
     return 0
 
 

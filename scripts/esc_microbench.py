@@ -246,6 +246,9 @@ def main():
     finally:
         obs.set_enabled(False)
     dispatches = obs.export.dispatch_summary()
+    # collect while the ledger snapshot still holds the run: census
+    # coverage is judged against the dispatch records above
+    memory = obs.export.memory_summary()
     for k in ("COMBBLAS_TPU_FUSED_KEY", "COMBBLAS_TPU_PALLAS_EXPAND",
               *_LOCAL_ENV):
         os.environ.pop(k, None)
@@ -287,6 +290,7 @@ def main():
                     "asserted across every variant per workload.",
         },
         "dispatch_summary": dispatches,
+        "memory_summary": memory,
         "roofline": dispatches.get("efficiency"),
         "note": "median wall time of the full jitted ESC SpGEMM "
                 "(expand + sort + dedup + re-sort) divided by flops_cap; "

@@ -128,6 +128,8 @@ def main():
               file=sys.stderr, flush=True)
     breakdown = obs.export.phase_breakdown()
     dispatches = obs.dispatch_summary()
+    # memory block before the sync-reference replay resets the ledger
+    memory = obs.memory_summary()
     fused_stats = iter_dispatch_stats(iters)
     print(obs.export.format_report(min_s=0.01), file=sys.stderr, flush=True)
     print(obs.ledger.format_table(), file=sys.stderr, flush=True)
@@ -179,6 +181,7 @@ def main():
         "spans": obs.export.report(),
         "metrics": obs.REGISTRY.snapshot(),
         "dispatch_summary": dispatches,
+        "memory_summary": memory,
         "roofline": dispatches.get("efficiency"),
         "dispatch": {
             **fused_stats,

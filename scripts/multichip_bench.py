@@ -321,6 +321,7 @@ def main():
     if "bits" in kinds:
         sections["bits"] = run_bits(args)
     summary = obs.dispatch_summary()
+    memory = obs.memory_summary()
     obs.set_enabled(False)
 
     headline = {
@@ -331,6 +332,7 @@ def main():
         "kinds": list(kinds),
         **{k: v for k, v in sections.items()},
         "dispatch_summary": summary,
+        "memory_summary": memory,
         "roofline": summary.get("efficiency"),
         "note": "dryrun: full correctness sweep on the virtual mesh. "
                 "spgemm: per-round exchanged bytes of the hybrid "

@@ -212,6 +212,7 @@ def main():
         wall = time.perf_counter() - t0
         svc.stop()
         dispatches = obs.dispatch_summary()
+        memory = obs.memory_summary()
         obs.set_enabled(False)
         served = nq - shed
         rec = {"mode": mode, "wall_s": round(wall, 4),
@@ -226,6 +227,7 @@ def main():
                "plan_cache": svc.plans.stats(),
                "rejected": svc.stats["rejected"],
                "dispatch_summary": dispatches,
+               "memory_summary": memory,
                "roofline": dispatches.get("efficiency")}
         print(json.dumps(rec), flush=True)
         return rec
@@ -407,6 +409,7 @@ def run_bits(args):
             cnt = sum(s["count"] for s in occ["series"])
             occ_mean = round(tot / cnt, 4) if cnt else None
         dispatches = obs.dispatch_summary()
+        memory = obs.memory_summary()
         rec = {"mode": f"serve_{name}", "wall_s": round(wall, 4),
                "qps": round(nq / wall, 2),
                "bfs_dispatches": bfs_disp,
@@ -415,6 +418,7 @@ def run_bits(args):
                "buckets": list(cfg.buckets),
                "plan_cache": svc.plans.stats(),
                "dispatch_summary": dispatches,
+               "memory_summary": memory,
                "roofline": dispatches.get("efficiency")}
         svc.stop()
         obs.set_enabled(False)

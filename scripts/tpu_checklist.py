@@ -38,6 +38,11 @@ Steps (each standalone, continues past failures):
      SUMMA exchange must reproduce the forced-dense product
      bit-exactly with its sparse broadcasts on the ledger. Skips when
      fewer than 4 devices are attached.
+  0g. (--mem) memory-ledger smoke: one tiny phased A*A with the
+     compile-time footprint census on; census coverage must reach
+     90% of in-wrapper compiles, the donation audit must report zero
+     unhonored donations against THIS backend's executables, and the
+     memory_summary block must carry its hbm_bytes capacity verdict.
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -389,6 +394,78 @@ def run_esc_check(grid) -> bool:
     return ok
 
 
+def run_mem_check(grid) -> bool:
+    """Step 0g: memory-ledger smoke — one tiny phased A*A with the
+    footprint census on; the census must cover every in-wrapper
+    compile, the donation audit must report zero unhonored donations
+    on THIS backend's compiled executables, and the memory_summary
+    block must carry a capacity verdict against the configured
+    hbm_bytes. Proves the OOM-risk gate's inputs exist before any
+    long step runs unbudgeted."""
+    import jax
+    import jax.numpy as jnp
+
+    from combblas_tpu import obs
+    from combblas_tpu.obs import memledger
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm, spgemm as spg
+
+    step("0g. memory-ledger smoke (--mem)")
+    ok = True
+    try:
+        memledger.reset()
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+        obs.set_enabled(True)
+        try:
+            n = 1 << 8
+            r, c = generate.rmat_edges(jax.random.key(7), 8, 8)
+            a = dm.from_global_coo(S.PLUS, grid, r, c,
+                                   jnp.ones_like(r, jnp.float32), n, n)
+            cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2)
+            cm.vals.block_until_ready()
+            summary = obs.export.memory_summary()
+        finally:
+            obs.set_enabled(False)
+        cov = summary["census_coverage"]
+        print(f"  census: {summary['census']['executables']} "
+              f"executables, coverage {cov['frac']:.0%} of "
+              f"{cov['expected']} compiled ledger names")
+        if cov["frac"] < 0.9:
+            print(f"FAIL: footprint census covered {cov['frac']:.0%} "
+                  "(< 90%) of the compiled executables — compile-time "
+                  "memory attribution is broken on this backend")
+            ok = False
+        audit = summary["donation_audit"]
+        print(f"  donations: {audit['declared']} declared, "
+              f"unhonored={audit['unhonored']} "
+              f"waived={audit['waived']}")
+        if audit["unhonored"]:
+            print("FAIL: declared donations NOT honored by this "
+                  f"backend's executables: {audit['unhonored']} — "
+                  "buffers are retained at every dispatch")
+            ok = False
+        if not summary.get("hbm_bytes"):
+            print("FAIL: memory_summary carries no hbm_bytes — "
+                  "backend_peaks() has no capacity entry")
+            ok = False
+        else:
+            print(f"  headroom: {summary['headroom_frac']:.1%} of "
+                  f"{summary['hbm_bytes'] / 1e9:.1f} GB "
+                  f"(peak resident {summary['peak_resident_bytes']} B, "
+                  f"largest footprint "
+                  f"{summary['largest_footprint_bytes']} B)")
+    except Exception:
+        traceback.print_exc()
+        return False
+    finally:
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+        memledger.reset()
+    print("memory ledger:", "OK" if ok else "FAILED")
+    return ok
+
+
 def run_mesh_check() -> bool:
     """Step 0e: scale-out smoke on a 2x2 submesh — the serve bits
     path must resolve (not fall back) on a routed square mesh, the
@@ -513,6 +590,11 @@ def main():
                          "matches the dense batch, hybrid SUMMA "
                          "exchange bit-exact vs forced dense (skips "
                          "when <4 devices)")
+    ap.add_argument("--mem", action="store_true",
+                    help="memory-ledger smoke: tiny phased A*A with "
+                         "the footprint census on; census coverage "
+                         ">= 90%%, zero unhonored donations, capacity "
+                         "verdict present")
     args = ap.parse_args()
     if args.analysis and not run_analysis_gate():
         sys.exit(1)
@@ -539,6 +621,8 @@ def main():
     if args.esc and not run_esc_check(grid):
         sys.exit(1)
     if args.mesh and not run_mesh_check():
+        sys.exit(1)
+    if args.mem and not run_mem_check(grid):
         sys.exit(1)
 
     step("1. pallas scan on-chip")
