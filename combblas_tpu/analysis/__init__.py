@@ -1,7 +1,7 @@
 """`combblas_tpu.analysis` — static-analysis gate for the repo's
 structural invariants.
 
-Six passes, one verdict (see `scripts/analyze.py --gate` and the
+Seven passes, one verdict (see `scripts/analyze.py --gate` and the
 README "Static analysis" section):
 
 1. **Budget engine** (`budget.run_budgets`) — lowers registered
@@ -36,6 +36,16 @@ README "Static analysis" section):
    fraction of the backend's `hbm_bytes`, footprint-census coverage
    floors, and the donation contract (no declared `donate_argnums`
    the compiled executable silently ignored).
+7. **trace-hazard & collective-safety lint** (`tracehazard.run_tracehazard`)
+   — interprocedural AST pass (`budgets/trace_hazard.json`): blocking
+   host syncs reachable from the registered async hot paths outside
+   the `obs.ledger.readback` brackets (the PR-7 pipeline property),
+   `os.environ` reads inside traced code (the PR-8 stale-executable
+   shape), unstable jit cache keys (per-call `jax.jit`, mutable
+   closure captures, literal static args), and shard_map collectives
+   checked against their declared mesh axes — with the square-mesh
+   transpose ppermute pairings pinned in the budget so rectangular/3D
+   mesh work fails loudly.
 
 All passes are trace/AST/JSON only — nothing here compiles or
 executes device code — and every finding carries `file:line`, a rule
@@ -80,8 +90,13 @@ def run_mem(**kw):
     return membudget.run_mem(**kw)
 
 
+def run_tracehazard(**kw):
+    from combblas_tpu.analysis import tracehazard
+    return tracehazard.run_tracehazard(**kw)
+
+
 def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
-                    "mem")) -> list[Finding]:
+                    "mem", "trace")) -> list[Finding]:
     """Run the selected passes; returns all unsuppressed findings
     (empty = gate passes)."""
     out: list[Finding] = []
@@ -97,4 +112,6 @@ def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
         out += run_perf()
     if "mem" in passes:
         out += run_mem()
+    if "trace" in passes:
+        out += run_tracehazard()
     return out

@@ -110,7 +110,7 @@ def _trace_views(fn, args) -> tuple[str, dict]:
     """(stablehlo text, jaxpr primitive histogram) from ONE trace when
     the AOT `.trace()` API is available, else two."""
     import jax
-    jitted = jax.jit(fn)
+    jitted = jax.jit(fn)  # analysis: allow(cache-key-unstable) analysis-only trace, never dispatched
     if hasattr(jitted, "trace"):
         traced = jitted.trace(*args)
         txt = traced.lower().as_text()

@@ -20,6 +20,7 @@ budget entry, so the waiver lives next to the number it waives.
 
 from __future__ import annotations
 
+import ast
 import dataclasses
 import re
 
@@ -57,6 +58,23 @@ PERF_STALE = "perf-stale-trajectory"        # BENCH_TRAJECTORY.json missing,
 #                                             unreadable, or not covering a
 #                                             committed artifact
 
+# trace-hazard & collective-safety lint (pass 7)
+SYNC_IN_ASYNC = "sync-in-async"          # blocking host sync reachable from a
+#                                          registered async hot path, outside
+#                                          an obs.ledger.readback bracket
+ENV_IN_TRACE = "env-in-trace"            # os.environ / utils.config read
+#                                          inside traced code (the PR-8 shape)
+CACHE_KEY_UNSTABLE = "cache-key-unstable"  # jit cache keyed on an unstable
+#                                            value: per-call jax.jit, mutable
+#                                            closure capture, literal static arg
+COLLECTIVE_AXIS = "collective-axis"      # collective inside a shard_map body
+#                                          over an axis its specs don't declare
+COLLECTIVE_TRANSPOSE = "collective-transpose"  # multi-axis ppermute (the
+#                                          square-mesh transpose pairing) not
+#                                          covered by the trace_hazard budget
+TRACE_STALE = "trace-stale-budget"       # trace_hazard.json names a function
+#                                          / site that no longer exists
+
 # memory-budget gate over bench memory_summary blocks (pass 6)
 MEM_TEMP = "mem-temp-ceiling"            # per-executable temp bytes over
 #                                          the committed ceiling
@@ -77,6 +95,8 @@ ALL_RULES = (
     OBS_RESIDUAL, OBS_DISPATCH_COUNT, OBS_STALE,
     PERF_EFFICIENCY, PERF_REGRESSION, PERF_STALE,
     MEM_TEMP, MEM_PEAK, MEM_DONATION, MEM_CENSUS, MEM_STALE,
+    SYNC_IN_ASYNC, ENV_IN_TRACE, CACHE_KEY_UNSTABLE, COLLECTIVE_AXIS,
+    COLLECTIVE_TRANSPOSE, TRACE_STALE,
 )
 
 
@@ -120,6 +140,43 @@ def is_suppressed(finding: Finding, suppressions: dict[int, set[str]],
         if rules and (finding.rule in rules or "*" in rules):
             return True
     return False
+
+
+def with_scope_map(tree: ast.AST) -> dict[int, tuple[int, ...]]:
+    """Map each 1-indexed source line to the lines of every ``with``
+    statement lexically enclosing it. This is the block-scope half of
+    the suppression contract — ``# analysis: allow(rule)`` on a
+    ``with`` line covers the whole block — hoisted here so EVERY AST
+    pass honors it, not just the lock lint (which used to carry its
+    own copy keyed off held locks)."""
+    out: dict[int, tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        for ln in range(node.lineno, end + 1):
+            out[ln] = out.get(ln, ()) + (node.lineno,)
+    return out
+
+
+class FileSuppressions:
+    """One file's suppression view: the ``# analysis: allow(...)``
+    line comments plus the with-block scope map. AST passes build one
+    per file and ask `covers(finding)`; passes that track extra scope
+    of their own (the lock lint's held-with lines) pass it through
+    ``extra_scope``."""
+
+    def __init__(self, source: str):
+        self.lines = scan_suppressions(source)
+        try:
+            self.scopes = with_scope_map(ast.parse(source))
+        except SyntaxError:
+            self.scopes = {}
+
+    def covers(self, finding: Finding,
+               extra_scope: tuple[int, ...] = ()) -> bool:
+        scope = self.scopes.get(finding.line, ()) + tuple(extra_scope)
+        return is_suppressed(finding, self.lines, scope)
 
 
 def format_report(findings: list[Finding], header: str = "") -> str:

@@ -26,7 +26,7 @@ import jax
 def lower_text(fn, *args) -> str:
     """Unoptimized StableHLO text of ``jit(fn)(*args)`` — trace only,
     nothing is compiled or executed."""
-    return jax.jit(fn).lower(*args).as_text()
+    return jax.jit(fn).lower(*args).as_text()  # analysis: allow(cache-key-unstable) analysis-only lowering, never dispatched
 
 
 def op_histogram(txt: str) -> dict[str, int]:

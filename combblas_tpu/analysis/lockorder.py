@@ -491,20 +491,24 @@ class Analyzer:
 
 def run_lockorder(paths=None) -> list[Finding]:
     """Lint the package (default `combblas_tpu/`); returns findings
-    that survive `# analysis: allow(...)` suppressions."""
+    that survive `# analysis: allow(...)` suppressions. Block scope
+    comes from `core.FileSuppressions` (every enclosing `with` line);
+    the analyzer's own scope tuples (held-lock with lines, which may
+    anchor in a DIFFERENT function for cycle edges) ride along as
+    extra scope."""
     if paths is None:
         paths = [pathlib.Path(__file__).parents[1]]
     an = Analyzer(paths)
     raw = an.run()
-    sup_cache: dict[str, dict] = {}
+    sup_cache: dict[str, core.FileSuppressions] = {}
     out = []
     for finding, scope in raw:
-        sups = sup_cache.get(finding.file)
-        if sups is None:
-            sups = core.scan_suppressions(
+        fs = sup_cache.get(finding.file)
+        if fs is None:
+            fs = core.FileSuppressions(
                 pathlib.Path(finding.file).read_text())
-            sup_cache[finding.file] = sups
-        if not core.is_suppressed(finding, sups, scope):
+            sup_cache[finding.file] = fs
+        if not fs.covers(finding, scope):
             out.append(finding)
     return out
 

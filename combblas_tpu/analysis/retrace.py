@@ -239,7 +239,7 @@ def empirical_compile_count(fn: Callable, arg_sets: list[tuple]) -> int:
         n[0] += 1
         return fn(*args)
 
-    jitted = jax.jit(counted)
+    jitted = jax.jit(counted)  # analysis: allow(cache-key-unstable) fresh cache IS the point: empirical compile counter
     for args in arg_sets:
         jax.block_until_ready(jitted(*args))
     return n[0]

@@ -1,0 +1,999 @@
+"""Pass 7 — trace-hazard & collective-safety lint.
+
+An interprocedural AST pass over the package (plus a small jaxpr arm
+for the mesh entry points) that enforces the four discipline
+properties the last three perf PRs each re-learned the hard way:
+
+* `sync-in-async` — a blocking host sync (`.item()`, `np.asarray`,
+  `block_until_ready`, `jax.device_get`, `float()`/`int()`/`bool()`
+  over a device readback, implicit `__bool__` on a device field)
+  reachable from a REGISTERED async hot path (`budgets/
+  trace_hazard.json` `async_roots`: the phased window loop, the MCL
+  fused mega-step, serve dispatch) that is not routed through the
+  sanctioned ledger brackets (`obs.ledger.readback(...)` /
+  `readback_deferred(...).resolve()`). One stray `.item()` in the
+  window loop re-serializes every dispatch (the PR-7 pipeline's whole
+  win).
+* `env-in-trace` — an `os.environ` / `os.getenv` read inside any
+  function reachable from traced code (jit-decorated, wrapped by
+  `jax.jit(...)`, passed to `jax.shard_map` / `lax` control flow, or
+  called from such a function). An env read at trace time is
+  invisible to the jit cache: flipping the flag later silently reuses
+  the stale executable — the exact PR-8 bug, which aliased the Pallas
+  hash path onto the XLA fallback.
+* `cache-key-unstable` — the static extension of the pass-2 retrace
+  detector: `jax.jit(...)` evaluated inside a function body (a fresh
+  compile cache per call), a traced function reading a module-level
+  mutable container that the package also mutates (the trace
+  snapshots it; later mutation = silent stale answer), and call sites
+  passing a literal lambda/list/dict in a declared `static_argnums`/
+  `static_argnames` position (a fresh cache key per call).
+* `collective-axis` / `collective-transpose` — every resolvable
+  `psum`/`all_gather`/`ppermute`/`pvary`/`axis_index` axis inside a
+  `shard_map` body is checked against the axis names its own
+  `in_specs`/`out_specs` declare (and the global axis vocabulary
+  `r`/`c`/`l`); multi-axis `ppermute` (the square-mesh transpose
+  pairing in `bfs_batch_bits_mesh` / `fastsv`) must be declared in
+  `budgets/trace_hazard.json` `transpose_pairs`, so the 3D /
+  rectangular-mesh work fails loudly instead of silently misrouting.
+
+Resolution is deliberately conservative, in the `lockorder.py` style:
+calls resolve through bare names (nested > module scope), `self.`
+methods, and module aliases; a name that cannot be resolved is
+skipped, never guessed. Lambda bodies are scanned as part of their
+enclosing function. Nested defs are assumed called by their parent
+(true for every hot path here; conservative elsewhere).
+
+Waive a finding with ``# analysis: allow(<rule>)`` on the flagged
+line, the line above, or any enclosing ``with`` statement's line
+(`core.FileSuppressions`); budget-anchored findings are waived via
+the JSON ``"allow"`` lists.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import pathlib
+from typing import Optional
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+
+BUDGET_FILE = (pathlib.Path(__file__).parent / "budgets"
+               / "trace_hazard.json")
+
+#: fully-qualified callables whose function argument is traced
+TRACE_ENTRIES = frozenset({
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.grad", "jax.value_and_grad",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.checkpoint", "jax.remat",
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.scan",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan",
+})
+
+#: collective terminals checked inside shard_map bodies, mapped to the
+#: positional index of their axis-name argument
+COLLECTIVES = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "psum_scatter": 1,
+    "all_gather": 1, "ppermute": 1, "all_to_all": 1, "pvary": 1,
+    "axis_index": 0, "pbroadcast": 1, "axis_size": 0,
+}
+
+#: context-manager terminals that sanction a blocking readback (the
+#: obs.ledger flight-recorder brackets)
+_SANCTIONED_CTX = frozenset({"readback", "readback_deferred", "resolve"})
+
+#: attribute terminals treated as device-resident fields for the
+#: implicit-__bool__ / int()/float() arms (the Tile/DistSpMat payload)
+_DEVICE_ATTRS = frozenset({"nnz", "vals", "rows", "cols", "data"})
+
+#: receiver-method terminals that return HOST values — poll/metadata
+#: calls that look like readbacks but never block
+_NONBLOCKING_TERMINALS = frozenset({"is_ready", "is_deleted"})
+
+
+def _dotted(node) -> Optional[list[str]]:
+    """Attribute chain as names: jax.lax.psum -> [jax, lax, psum]."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+def _line_of(text: str, anchor: str, fallback: int = 1) -> int:
+    for i, ln in enumerate(text.splitlines(), start=1):
+        if anchor in ln:
+            return i
+    return fallback
+
+
+def load_budget(path=None) -> dict:
+    path = pathlib.Path(path or BUDGET_FILE)
+    return json.loads(path.read_text())
+
+
+@dataclasses.dataclass
+class CallEdge:
+    line: int
+    target: Optional[tuple]          # (module, qual) when resolved
+    terminal: str
+
+
+@dataclasses.dataclass
+class SyncSite:
+    line: int
+    what: str                        # human label, e.g. ".item()"
+    sanctioned: bool                 # inside a ledger readback bracket
+
+
+@dataclasses.dataclass
+class FuncNode:
+    key: tuple                       # (module name, dotted qual)
+    file: str
+    line: int
+    node: object                     # the ast def node
+    cls: Optional[str] = None        # enclosing class name, if a method
+    parent: Optional[tuple] = None   # enclosing function's key
+    nested: list = dataclasses.field(default_factory=list)
+    calls: list = dataclasses.field(default_factory=list)
+    env_reads: list = dataclasses.field(default_factory=list)  # (line, what)
+    sync_sites: list = dataclasses.field(default_factory=list)
+    traced: bool = False             # jitted / passed to a trace entry
+    jit_static: Optional[dict] = None  # {"argnums": [...], "argnames": [...]}
+
+    @property
+    def full(self) -> str:
+        return f"{self.key[0]}.{self.key[1]}"
+
+
+def _qual_match(full: str, pattern: str) -> bool:
+    """Dotted suffix match in either direction, so budget qualnames
+    written against the package match fixture/tmp modules whose
+    module name is just the file stem."""
+    return (full == pattern or full.endswith("." + pattern)
+            or pattern.endswith("." + full))
+
+
+class _Module:
+    def __init__(self, path: pathlib.Path, pkg_root: pathlib.Path):
+        self.path = path
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=str(path))
+        try:
+            rel = path.relative_to(pkg_root.parent)
+            self.name = str(rel.with_suffix("")).replace("/", ".")
+        except ValueError:
+            self.name = path.stem
+        self.aliases: dict[str, str] = {}
+        self.constants: dict[str, str] = {}   # NAME -> string constant
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    self.aliases[al.asname or al.name.split(".")[0]] = (
+                        al.name)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for al in node.names:
+                    self.aliases[al.asname or al.name] = (
+                        f"{node.module}.{al.name}")
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                self.constants[node.targets[0].id] = node.value.value
+
+    def resolve(self, d: list[str]) -> str:
+        """Dotted chain -> fully-qualified name via the import map."""
+        root = self.aliases.get(d[0], d[0])
+        return ".".join([root] + d[1:])
+
+
+class Analyzer:
+    """Build the function/call graph, then check the four rule
+    families. `run()` returns RAW findings (no suppression filtering —
+    the seen-and-waived audit tests rely on that); `run_tracehazard`
+    applies `core.FileSuppressions` and the budget allow lists."""
+
+    def __init__(self, paths, budget: Optional[dict] = None):
+        self.budget = budget if budget is not None else load_budget()
+        self.budget_file = str(BUDGET_FILE)
+        self.modules: list[_Module] = []
+        self.funcs: dict[tuple, FuncNode] = {}
+        self.mutated_globals: set[tuple] = set()   # (module, name)
+        self.mutable_globals: dict[tuple, int] = {}  # (module, name) -> line
+        for root in [pathlib.Path(p) for p in paths]:
+            files = ([root] if root.is_file()
+                     else sorted(root.rglob("*.py")))
+            for f in files:
+                self.modules.append(_Module(
+                    f, root if root.is_dir() else root.parent))
+
+    # -- phase 1: the function table -----------------------------------
+
+    def _collect_funcs(self, m: _Module) -> None:
+        def rec(stmts, qual, cls, parent):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{qual}.{st.name}" if qual else st.name
+                    fn = FuncNode((m.name, q), str(m.path), st.lineno,
+                                  st, cls=cls, parent=parent)
+                    self.funcs[fn.key] = fn
+                    if parent is not None:
+                        self.funcs[parent].nested.append(fn.key)
+                    rec(st.body, q, cls, fn.key)
+                elif isinstance(st, ast.ClassDef):
+                    q = f"{qual}.{st.name}" if qual else st.name
+                    rec(st.body, q, st.name, parent)
+                else:
+                    for blk in ("body", "orelse", "finalbody"):
+                        rec(getattr(st, blk, []) or [], qual, cls, parent)
+                    for h in getattr(st, "handlers", []) or []:
+                        rec(h.body, qual, cls, parent)
+        rec(m.tree.body, "", None, None)
+        for node in m.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, (ast.Dict, ast.List,
+                                                ast.Set, ast.DictComp,
+                                                ast.ListComp,
+                                                ast.SetComp))):
+                self.mutable_globals[(m.name, node.targets[0].id)] = (
+                    node.lineno)
+
+    # -- resolution helpers --------------------------------------------
+
+    def _resolve_name(self, m: _Module, fn: Optional[FuncNode],
+                      name: str) -> Optional[tuple]:
+        """Bare name -> FuncNode key: nested siblings of the enclosing
+        chain first, then module scope, then from-imports."""
+        cur = fn
+        while cur is not None:
+            for k in cur.nested:
+                if k[1].rsplit(".", 1)[-1] == name:
+                    return k
+            cur = self.funcs.get(cur.parent) if cur.parent else None
+        if (m.name, name) in self.funcs:
+            return (m.name, name)
+        full = m.aliases.get(name)
+        if full:
+            return self._match_full(full)
+        return None
+
+    def _match_full(self, full: str) -> Optional[tuple]:
+        for mod in self.modules:
+            pre = mod.name + "."
+            if full.startswith(pre):
+                qual = full[len(pre):]
+                if (mod.name, qual) in self.funcs:
+                    return (mod.name, qual)
+        return None
+
+    def _resolve_call(self, m: _Module, fn: FuncNode,
+                      call: ast.Call) -> CallEdge:
+        d = _dotted(call.func)
+        ev = CallEdge(call.lineno, None, d[-1] if d else "<expr>")
+        if not d:
+            return ev
+        if len(d) == 1:
+            ev.target = self._resolve_name(m, fn, d[0])
+        elif d[0] == "self" and fn.cls is not None and len(d) == 2:
+            # method on the enclosing class (qual may be Class.meth)
+            holder = fn.key[1].rsplit(".", 2)
+            for cand in (f"{fn.cls}.{d[1]}",):
+                if (m.name, cand) in self.funcs:
+                    ev.target = (m.name, cand)
+            _ = holder
+        else:
+            ev.target = self._match_full(m.resolve(d))
+        return ev
+
+    # -- phase 2: per-function walk ------------------------------------
+
+    def _walk_function(self, m: _Module, fn: FuncNode) -> None:
+        node = fn.node
+
+        # decorators: jit-decorated -> traced; jit decorator on a
+        # NESTED def is also a per-call jit (cache-key arm)
+        for dec in node.decorator_list:
+            info = self._jit_call_info(m, dec)
+            if info is not None:
+                fn.traced = True
+                fn.jit_static = info
+                if fn.parent is not None:
+                    fn.calls.append(CallEdge(dec.lineno, None,
+                                             "jit-in-body"))
+
+        def iter_no_defs(n):
+            """Walk an expression/statement subtree without entering
+            nested def bodies (lambdas ARE entered — they execute in
+            the enclosing context often enough to matter)."""
+            stack = [n]
+            while stack:
+                cur = stack.pop()
+                yield cur
+                for child in ast.iter_child_nodes(cur):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        continue
+                    stack.append(child)
+
+        def scan_expr(n, sanctioned):
+            if n is None:
+                return
+            for sub in iter_no_defs(n):
+                if isinstance(sub, ast.Call):
+                    self._scan_call(m, fn, sub, sanctioned)
+                elif isinstance(sub, ast.Subscript):
+                    d = _dotted(sub.value)
+                    if d and m.resolve(d) == "os.environ":
+                        fn.env_reads.append((sub.lineno, "os.environ[...]"))
+                elif isinstance(sub, (ast.If, ast.While)):
+                    self._implicit_bool(fn, sub.test)
+                elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    self._note_global_mutation(m, sub)
+
+        def walk_stmts(stmts, sanctioned):
+            for st in stmts:
+                if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    sanct = sanctioned
+                    for item in st.items:
+                        ce = item.context_expr
+                        scan_expr(ce, sanctioned)
+                        if (isinstance(ce, ast.Call)
+                                and (_dotted(ce.func) or ["?"])[-1]
+                                in _SANCTIONED_CTX):
+                            sanct = True
+                    walk_stmts(st.body, sanct)
+                elif isinstance(st, ast.Try):
+                    for part in (st.body, st.orelse, st.finalbody):
+                        walk_stmts(part, sanctioned)
+                    for h in st.handlers:
+                        walk_stmts(h.body, sanctioned)
+                elif isinstance(st, (ast.If, ast.While)):
+                    scan_expr(st.test, sanctioned)
+                    self._implicit_bool(fn, st.test)
+                    walk_stmts(st.body, sanctioned)
+                    walk_stmts(st.orelse, sanctioned)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    scan_expr(st.iter, sanctioned)
+                    walk_stmts(st.body, sanctioned)
+                    walk_stmts(st.orelse, sanctioned)
+                else:
+                    scan_expr(st, sanctioned)
+
+        walk_stmts(node.body, False)
+
+    def _jit_call_info(self, m: _Module, expr) -> Optional[dict]:
+        """`jax.jit` / `partial(jax.jit, ...)` expression -> static-arg
+        info dict, else None."""
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d is None:
+                return None
+            full = m.resolve(d)
+            if full == "jax.jit":
+                return self._static_info(expr)
+            if full in ("functools.partial", "partial") and expr.args:
+                inner = _dotted(expr.args[0])
+                if inner and m.resolve(inner) == "jax.jit":
+                    return self._static_info(expr)
+            return None
+        d = _dotted(expr)
+        if d and m.resolve(d) == "jax.jit":
+            return {"argnums": (), "argnames": ()}
+        return None
+
+    @staticmethod
+    def _static_info(call: ast.Call) -> dict:
+        def lits(kwname):
+            for kw in call.keywords:
+                if kw.arg == kwname:
+                    vals = []
+                    nodes = (kw.value.elts
+                             if isinstance(kw.value, (ast.Tuple, ast.List))
+                             else [kw.value])
+                    for e in nodes:
+                        if isinstance(e, ast.Constant):
+                            vals.append(e.value)
+                    return tuple(vals)
+            return ()
+        return {"argnums": lits("static_argnums"),
+                "argnames": lits("static_argnames")}
+
+    def _implicit_bool(self, fn: FuncNode, test) -> None:
+        d = _dotted(test)
+        if d and len(d) >= 2 and d[-1] in _DEVICE_ATTRS:
+            fn.sync_sites.append(SyncSite(
+                test.lineno, f"implicit __bool__ on .{d[-1]}", False))
+
+    def _note_global_mutation(self, m: _Module, st) -> None:
+        tgt = st.target if isinstance(st, ast.AugAssign) else (
+            st.targets[0] if st.targets else None)
+        if isinstance(tgt, ast.Subscript) and isinstance(tgt.value,
+                                                        ast.Name):
+            self.mutated_globals.add((m.name, tgt.value.id))
+
+    def _scan_call(self, m: _Module, fn: FuncNode, call: ast.Call,
+                   sanctioned: bool) -> None:
+        d = _dotted(call.func)
+        if d is None:
+            return
+        full = m.resolve(d)
+        terminal = d[-1]
+
+        # mutation terminals on module globals (.append/.update/...)
+        if (terminal in ("append", "update", "add", "extend", "insert",
+                         "setdefault", "pop", "clear")
+                and len(d) == 2):
+            self.mutated_globals.add((m.name, d[0]))
+
+        # env reads
+        if full == "os.getenv" or full.startswith("os.environ."):
+            fn.env_reads.append((call.lineno, full))
+
+        # sync terminals
+        if terminal == "item" and not call.args and len(d) >= 2:
+            fn.sync_sites.append(SyncSite(call.lineno, ".item()",
+                                          sanctioned))
+        elif full in ("numpy.asarray", "numpy.array"):
+            # a literal list/tuple/genexp argument is host-side
+            # construction, not a device readback
+            arg0 = call.args[0] if call.args else None
+            if not isinstance(arg0, (ast.List, ast.ListComp, ast.Tuple,
+                                     ast.GeneratorExp, ast.Constant)):
+                fn.sync_sites.append(SyncSite(
+                    call.lineno, f"{d[0]}.{terminal}(...)", sanctioned))
+        elif terminal == "block_until_ready":
+            fn.sync_sites.append(SyncSite(
+                call.lineno, "block_until_ready", sanctioned))
+        elif full == "jax.device_get":
+            fn.sync_sites.append(SyncSite(call.lineno, "jax.device_get",
+                                          sanctioned))
+        elif (len(d) == 1 and terminal in ("float", "int", "bool")
+                and call.args):
+            arg = call.args[0]
+            ad = _dotted(arg)
+            if (ad and len(ad) >= 2 and ad[-1] in _DEVICE_ATTRS):
+                fn.sync_sites.append(SyncSite(
+                    call.lineno,
+                    f"{terminal}() over device field .{ad[-1]}",
+                    sanctioned))
+
+        # trace entries: mark function-valued args as traced
+        if full in TRACE_ENTRIES or terminal == "shard_map":
+            cands = list(call.args[:1])
+            if full in ("jax.lax.while_loop", "jax.lax.cond"):
+                cands = list(call.args[:2])
+            elif full == "jax.lax.switch":
+                cands = list(call.args[1:2])
+                if (len(call.args) >= 2
+                        and isinstance(call.args[1],
+                                       (ast.Tuple, ast.List))):
+                    cands = list(call.args[1].elts)
+            elif full == "jax.lax.fori_loop":
+                cands = list(call.args[2:3])
+            for a in cands:
+                tgt = None
+                if isinstance(a, ast.Name):
+                    tgt = self._resolve_name(m, fn, a.id)
+                if tgt is not None:
+                    tfn = self.funcs[tgt]
+                    tfn.traced = True
+                    if full == "jax.jit":
+                        tfn.jit_static = self._static_info(call)
+
+        # per-call jit construction (cache-key arm): any jax.jit
+        # evaluated inside a def body builds a fresh compile cache
+        info = self._jit_call_info(m, call)
+        if info is not None and isinstance(call.func, (ast.Attribute,
+                                                       ast.Name)):
+            d2 = _dotted(call.func)
+            if d2 and m.resolve(d2) == "jax.jit":
+                fn.calls.append(CallEdge(call.lineno, None,
+                                         "jit-in-body"))
+
+        fn.calls.append(self._resolve_call(m, fn, call))
+
+    # -- phase 3: closures ---------------------------------------------
+
+    def _closure(self, roots: list[tuple]) -> dict[tuple, tuple]:
+        """BFS over call edges + parent->nested edges; returns
+        reached key -> predecessor key (roots map to themselves)."""
+        pred: dict[tuple, tuple] = {r: r for r in roots}
+        work = list(roots)
+        while work:
+            k = work.pop()
+            fn = self.funcs.get(k)
+            if fn is None:
+                continue
+            succs = list(fn.nested)
+            succs += [ev.target for ev in fn.calls
+                      if ev.target is not None]
+            for s in succs:
+                if s not in pred:
+                    pred[s] = k
+                    work.append(s)
+        return pred
+
+    def _chain(self, pred: dict, key: tuple, limit: int = 6) -> str:
+        names = [key[1].rsplit(".", 1)[-1]]
+        cur = key
+        while pred.get(cur) != cur and len(names) < limit:
+            cur = pred[cur]
+            names.append(cur[1].rsplit(".", 1)[-1])
+        return " <- ".join(names)
+
+    # -- phase 4: findings ---------------------------------------------
+
+    def run(self) -> list[Finding]:
+        for m in self.modules:
+            self._collect_funcs(m)
+        mod_by_name = {m.name: m for m in self.modules}
+        for k, fn in self.funcs.items():
+            self._walk_function(mod_by_name[k[0]], fn)
+
+        out: list[Finding] = []
+        out += self._check_sync_in_async()
+        out += self._check_env_in_trace()
+        out += self._check_cache_keys(mod_by_name)
+        out += self._check_collectives(mod_by_name)
+        return out
+
+    def _async_roots(self) -> tuple[list[tuple], list[Finding]]:
+        roots, findings = [], []
+        try:
+            btext = pathlib.Path(self.budget_file).read_text()
+        except OSError:
+            btext = ""
+        for ent in self.budget.get("async_roots", ()):
+            q = ent["qualname"]
+            hits = [k for k, f in self.funcs.items()
+                    if _qual_match(f.full, q)]
+            if not hits:
+                findings.append(Finding(
+                    core.TRACE_STALE, self.budget_file,
+                    _line_of(btext, q),
+                    f"async root {q!r} matches no function in the "
+                    f"scanned tree — update trace_hazard.json"))
+            roots += hits
+        return roots, findings
+
+    def _check_sync_in_async(self) -> list[Finding]:
+        roots, out = self._async_roots()
+        pred = self._closure(roots)
+        for k in pred:
+            fn = self.funcs.get(k)
+            if fn is None:
+                continue
+            for site in fn.sync_sites:
+                if site.sanctioned:
+                    continue
+                out.append(Finding(
+                    core.SYNC_IN_ASYNC, fn.file, site.line,
+                    f"blocking host sync {site.what} on the async hot "
+                    f"path ({self._chain(pred, k)}) outside an "
+                    f"obs.ledger.readback/readback_deferred bracket — "
+                    f"this re-serializes the dispatch pipeline",
+                    entry=fn.full))
+        return out
+
+    def _check_env_in_trace(self) -> list[Finding]:
+        roots = [k for k, f in self.funcs.items() if f.traced]
+        pred = self._closure(roots)
+        out = []
+        for k in pred:
+            fn = self.funcs.get(k)
+            if fn is None:
+                continue
+            for line, what in fn.env_reads:
+                out.append(Finding(
+                    core.ENV_IN_TRACE, fn.file, line,
+                    f"{what} read inside traced code "
+                    f"({self._chain(pred, k)}): the value is baked "
+                    f"into the executable at trace time and invisible "
+                    f"to the jit cache — flipping it later silently "
+                    f"reuses the stale compile (the PR-8 bug shape)",
+                    entry=fn.full))
+        return out
+
+    def _check_cache_keys(self, mod_by_name) -> list[Finding]:
+        out = []
+        for k, fn in self.funcs.items():
+            for ev in fn.calls:
+                if ev.terminal == "jit-in-body":
+                    out.append(Finding(
+                        core.CACHE_KEY_UNSTABLE, fn.file, ev.line,
+                        f"jax.jit evaluated inside `{k[1]}` builds a "
+                        f"FRESH compile cache per call — hoist to "
+                        f"module scope or memoize via a plan cache",
+                        entry=fn.full))
+        # traced functions reading module-level mutable containers the
+        # package also mutates: the trace snapshots the value
+        for k, fn in self.funcs.items():
+            if not fn.traced:
+                continue
+            m = mod_by_name[k[0]]
+            reads = set()
+            for sub in ast.walk(fn.node):
+                if (isinstance(sub, ast.Name)
+                        and isinstance(sub.ctx, ast.Load)):
+                    g = (m.name, sub.id)
+                    if (g in self.mutable_globals
+                            and g in self.mutated_globals
+                            and g not in reads):
+                        reads.add(g)
+                        out.append(Finding(
+                            core.CACHE_KEY_UNSTABLE, fn.file,
+                            sub.lineno,
+                            f"traced `{k[1]}` closes over mutable "
+                            f"module global `{sub.id}` (mutated "
+                            f"elsewhere in the package): the compiled "
+                            f"executable keeps the trace-time "
+                            f"snapshot — a later mutation is a silent "
+                            f"stale answer", entry=fn.full))
+        # literal lambda/list/dict passed in a declared static position
+        for m in self.modules:
+            out += self._check_static_call_sites(m)
+        return out
+
+    def _check_static_call_sites(self, m: _Module) -> list[Finding]:
+        """Call sites of jit-wrapped names: a literal lambda/list/dict
+        in a static_argnums/static_argnames position mints a fresh
+        cache key per call."""
+        out = []
+        wrapped: dict[str, tuple] = {}   # local name -> (static info, params)
+        for node in ast.walk(m.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                info = self._jit_call_info(m, node.value)
+                if info is None or not node.value.args:
+                    continue
+                inner = _dotted(node.value.args[0] if m.resolve(
+                    _dotted(node.value.func) or ["?"]) != "jax.jit"
+                    else node.value.args[0])
+                # jax.jit(f, ...): wrapped fn is args[0]; partial form
+                # has jax.jit at args[0] and no wrapped fn yet
+                tgt = None
+                fd = _dotted(node.value.args[0])
+                if fd and len(fd) == 1:
+                    tgt = self._resolve_name(m, None, fd[0])
+                _ = inner
+                if tgt is None:
+                    continue
+                params = [a.arg for a in self.funcs[tgt].node.args.args]
+                wrapped[node.targets[0].id] = (info, params)
+        for k, fn in self.funcs.items():
+            if k[0] != m.name:
+                continue
+            name = k[1].rsplit(".", 1)[-1]
+            if fn.jit_static is not None and not fn.traced:
+                continue
+            if fn.jit_static is not None:
+                params = [a.arg for a in fn.node.args.args]
+                wrapped.setdefault(name, (fn.jit_static, params))
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in wrapped):
+                continue
+            info, params = wrapped[node.func.id]
+            static_pos = set(info["argnums"])
+            static_pos |= {params.index(n) for n in info["argnames"]
+                           if n in params}
+            for i, a in enumerate(node.args):
+                if i in static_pos and isinstance(
+                        a, (ast.Lambda, ast.List, ast.Dict, ast.Set)):
+                    out.append(Finding(
+                        core.CACHE_KEY_UNSTABLE, str(m.path),
+                        a.lineno,
+                        f"literal {type(a).__name__.lower()} passed in "
+                        f"static position {i} of jitted "
+                        f"`{node.func.id}`: a fresh object per call = "
+                        f"a fresh cache key per call (retrace drift)"))
+            for kw in node.keywords:
+                if kw.arg in info["argnames"] and isinstance(
+                        kw.value, (ast.Lambda, ast.List, ast.Dict,
+                                   ast.Set)):
+                    out.append(Finding(
+                        core.CACHE_KEY_UNSTABLE, str(m.path),
+                        kw.value.lineno,
+                        f"literal {type(kw.value).__name__.lower()} "
+                        f"passed as static `{kw.arg}` of jitted "
+                        f"`{node.func.id}`: a fresh object per call = "
+                        f"a fresh cache key per call (retrace drift)"))
+        return out
+
+    # -- collective safety ---------------------------------------------
+
+    def _axis_strings(self, m: _Module, fn: Optional[FuncNode], expr,
+                      local_assigns: dict, depth: int = 0) -> tuple:
+        """(resolved axis strings, unknown literal strings). Resolves
+        Name refs through module constants, imported axis constants,
+        and single local assignments."""
+        resolved, unknown = [], []
+        vocab = set(self.budget.get("axis_vocabulary", ()))
+        if expr is None or depth > 6:
+            return (), ()
+
+        def rec(e, depth):
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                (resolved if e.value in vocab else unknown).append(
+                    (e.value, e.lineno))
+            elif isinstance(e, (ast.Tuple, ast.List)):
+                for el in e.elts:
+                    rec(el, depth)
+            elif isinstance(e, ast.Call):
+                # P("r", None) partition specs: axis names are the args
+                for a in e.args:
+                    rec(a, depth)
+            elif isinstance(e, ast.BinOp):
+                # (P(...),) * 3 + (P(...),) spec arithmetic
+                rec(e.left, depth)
+                rec(e.right, depth)
+            elif isinstance(e, ast.IfExp):
+                rec(e.body, depth)
+                rec(e.orelse, depth)
+            elif isinstance(e, (ast.Name, ast.Attribute)):
+                d = _dotted(e)
+                if d is None:
+                    return
+                val = self._axis_const(m, d)
+                if val is not None:
+                    (resolved if val in vocab else unknown).append(
+                        (val, e.lineno))
+                elif (len(d) == 1 and d[0] in local_assigns
+                        and depth < 6):
+                    rec(local_assigns[d[0]], depth + 1)
+        rec(expr, depth)
+        return tuple(resolved), tuple(unknown)
+
+    def _axis_const(self, m: _Module, d: list[str]) -> Optional[str]:
+        if len(d) == 1 and d[0] in m.constants:
+            return m.constants[d[0]]
+        full = m.resolve(d)
+        for mod in self.modules:
+            pre = mod.name + "."
+            if full.startswith(pre):
+                name = full[len(pre):]
+                if name in mod.constants:
+                    return mod.constants[name]
+        return None
+
+    def _check_collectives(self, mod_by_name) -> list[Finding]:
+        out: list[Finding] = []
+        matched_pairs: set[int] = set()
+        pairs = list(self.budget.get("transpose_pairs", ()))
+        for m in self.modules:
+            parents = {c: p for p in ast.walk(m.tree)
+                       for c in ast.iter_child_nodes(p)}
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = _dotted(node.func)
+                if not d or d[-1] != "shard_map":
+                    continue
+                out += self._check_one_shard_map(
+                    m, node, parents, pairs, matched_pairs)
+        try:
+            btext = pathlib.Path(self.budget_file).read_text()
+        except OSError:
+            btext = ""
+        for i, ent in enumerate(pairs):
+            if i not in matched_pairs and not ent.get("allow_stale"):
+                out.append(Finding(
+                    core.TRACE_STALE, self.budget_file,
+                    _line_of(btext, ent.get("function", "?")),
+                    f"transpose_pairs entry "
+                    f"{ent.get('module')}:{ent.get('function')} over "
+                    f"axes {ent.get('axes')} matches no multi-axis "
+                    f"ppermute in the tree — update "
+                    f"trace_hazard.json"))
+        return out
+
+    def _enclosing_topdef(self, parents, node) -> Optional[str]:
+        name = None
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = cur.name
+        return name
+
+    def _check_one_shard_map(self, m, call, parents, pairs,
+                             matched_pairs) -> list[Finding]:
+        out: list[Finding] = []
+        topdef = self._enclosing_topdef(parents, call)
+        # innermost enclosing FuncNode of the call site, so the body
+        # name resolves LEXICALLY (several functions in one module
+        # define a shard_map body named `f`)
+        encl_fn = None
+        cur = call
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for k, f in self.funcs.items():
+                    if k[0] == m.name and f.node is cur:
+                        encl_fn = f
+                break
+        # the body function: first positional arg
+        body_node = None
+        if call.args:
+            a = call.args[0]
+            if isinstance(a, ast.Lambda):
+                body_node = a
+            elif isinstance(a, ast.Name):
+                fn_key = self._resolve_name(m, encl_fn, a.id)
+                if fn_key is not None:
+                    body_node = self.funcs[fn_key].node
+        # local assignments in the enclosing function, for spec/axis
+        # indirection (spec4 = P(...); tperm = [...])
+        local_assigns: dict[str, object] = {}
+        encl = encl_fn.node if encl_fn is not None else None
+        if encl is not None:
+            for sub in ast.walk(encl):
+                if (isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)):
+                    local_assigns[sub.targets[0].id] = sub.value
+
+        spec_axes: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg in ("in_specs", "out_specs"):
+                res, _unk = self._axis_strings(m, None, kw.value,
+                                               local_assigns)
+                spec_axes |= {s for s, _ in res}
+        if body_node is None:
+            return out
+
+        for sub in ast.walk(body_node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if not d or d[-1] not in COLLECTIVES:
+                continue
+            full = m.resolve(d)
+            if not (full.startswith("jax.lax.") or full.startswith(
+                    "jax.") and d[-1] in COLLECTIVES and len(d) >= 2):
+                if len(d) == 1:
+                    continue
+            pos = COLLECTIVES[d[-1]]
+            axis_expr = None
+            if len(sub.args) > pos:
+                axis_expr = sub.args[pos]
+            else:
+                for kw in sub.keywords:
+                    if kw.arg in ("axis_name", "axes", "axis"):
+                        axis_expr = kw.value
+            if axis_expr is None:
+                continue
+            res, unk = self._axis_strings(m, None, axis_expr,
+                                          local_assigns)
+            for val, line in unk:
+                out.append(Finding(
+                    core.COLLECTIVE_AXIS, str(m.path), line,
+                    f"`{d[-1]}` over unknown axis name {val!r} — not "
+                    f"in the mesh axis vocabulary "
+                    f"{sorted(self.budget.get('axis_vocabulary', ()))} "
+                    f"(typo, or update trace_hazard.json)",
+                    entry=topdef or ""))
+            names = [v for v, _ in res]
+            if spec_axes:
+                for val, line in res:
+                    if val not in spec_axes:
+                        out.append(Finding(
+                            core.COLLECTIVE_AXIS, str(m.path), line,
+                            f"`{d[-1]}` over axis {val!r} but this "
+                            f"shard_map's in/out specs only declare "
+                            f"{sorted(spec_axes)} — on a mesh without "
+                            f"{val!r} this hangs or silently "
+                            f"misreduces", entry=topdef or ""))
+            # transpose pairing = a SYNTACTIC tuple of >=2 axes (an
+            # IfExp picking one axis per call is still single-axis)
+            ax = axis_expr
+            hops = 0
+            while (isinstance(ax, ast.Name) and ax.id in local_assigns
+                   and hops < 6):
+                ax = local_assigns[ax.id]
+                hops += 1
+            multi = (isinstance(ax, (ast.Tuple, ast.List))
+                     and len(ax.elts) >= 2)
+            if d[-1] == "ppermute" and multi and len(set(names)) >= 2:
+                hit = None
+                for i, ent in enumerate(pairs):
+                    if (_qual_match(m.name, ent.get("module", ""))
+                            and topdef == ent.get("function")
+                            and sorted(set(names))
+                            == sorted(set(ent.get("axes", ())))):
+                        hit = i
+                        break
+                if hit is not None:
+                    matched_pairs.add(hit)
+                else:
+                    out.append(Finding(
+                        core.COLLECTIVE_TRANSPOSE, str(m.path),
+                        sub.lineno,
+                        f"multi-axis ppermute over "
+                        f"{sorted(set(names))} in `{topdef}` is the "
+                        f"square-mesh transpose pairing — it silently "
+                        f"misroutes on rectangular/3D meshes. Guard "
+                        f"eligibility and declare it in "
+                        f"trace_hazard.json transpose_pairs",
+                        entry=topdef or ""))
+        return out
+
+
+# -- jaxpr arm: collective axes of a traced entry ----------------------
+
+def jaxpr_collective_axes(jaxpr) -> set[str]:
+    """All collective axis names appearing in a (Closed)Jaxpr,
+    recursively through nested call/control-flow jaxprs — the dynamic
+    cross-check the green mesh tests run against the declared axis
+    vocabulary."""
+    core_jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    axes: set[str] = set()
+
+    def visit(j):
+        for eqn in j.eqns:
+            for key in ("axes", "axis_name", "axis_index_groups"):
+                if key in ("axes", "axis_name") and key in eqn.params:
+                    v = eqn.params[key]
+                    vs = v if isinstance(v, (tuple, list)) else (v,)
+                    axes.update(x for x in vs if isinstance(x, str))
+            for v in eqn.params.values():
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None and hasattr(sub, "eqns"):
+                    visit(sub)
+                elif hasattr(v, "eqns"):
+                    visit(v)
+                elif isinstance(v, (tuple, list)):
+                    for vv in v:
+                        sub = getattr(vv, "jaxpr", None)
+                        if sub is not None and hasattr(sub, "eqns"):
+                            visit(sub)
+    visit(core_jaxpr)
+    return axes
+
+
+# -- entry point -------------------------------------------------------
+
+def run_tracehazard(paths=None, budget_file=None) -> list[Finding]:
+    """Run pass 7; returns findings surviving source suppressions
+    (`core.FileSuppressions`, so a waiver on a `with` line covers its
+    block) and the budget's `"allow"` rule list."""
+    if paths is None:
+        paths = [pathlib.Path(__file__).parents[1]]
+    bfile = pathlib.Path(budget_file or BUDGET_FILE)
+    budget = load_budget(bfile)
+    an = Analyzer(paths, budget)
+    an.budget_file = str(bfile)
+    raw = an.run()
+    allowed = set(budget.get("allow", ()))
+    sup_cache: dict[str, core.FileSuppressions] = {}
+    out = []
+    for f in raw:
+        if f.rule in allowed:
+            continue
+        if f.file == str(bfile):
+            out.append(f)
+            continue
+        fs = sup_cache.get(f.file)
+        if fs is None:
+            try:
+                fs = core.FileSuppressions(
+                    pathlib.Path(f.file).read_text())
+            except OSError:
+                fs = core.FileSuppressions("")
+            sup_cache[f.file] = fs
+        if not fs.covers(f):
+            out.append(f)
+    return out

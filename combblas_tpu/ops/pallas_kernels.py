@@ -54,7 +54,9 @@ def enabled() -> bool:
     COMBBLAS_TPU_PALLAS=0 force-disables. Non-TPU backends always take
     the XLA path (interpret mode is for tests, via the explicit
     ``interpret=True`` argument)."""
-    if os.environ.get("COMBBLAS_TPU_PALLAS", "") == "0":
+    # deliberate trace-time read: the flag selects which kernel gets
+    # traced; flips require jax.clear_caches() (tests do; see budget._Env)
+    if os.environ.get("COMBBLAS_TPU_PALLAS", "") == "0":  # analysis: allow(env-in-trace)
         return False
     try:
         return jax.default_backend() == "tpu"
@@ -244,7 +246,8 @@ EXPAND_BMAX = 1 << 19          # max B-table slots kept VMEM-resident
 
 
 def expand_mode() -> str:
-    return os.environ.get("COMBBLAS_TPU_PALLAS_EXPAND", "")
+    # trace-time kernel selector; flips require jax.clear_caches()
+    return os.environ.get("COMBBLAS_TPU_PALLAS_EXPAND", "")  # analysis: allow(env-in-trace)
 
 
 def expand_enabled() -> bool:
@@ -254,7 +257,7 @@ def expand_enabled() -> bool:
     COMBBLAS_TPU_PALLAS=0 still vetoes everything."""
     mode = expand_mode()
     if mode == "interpret":
-        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"
+        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"  # analysis: allow(env-in-trace) same clear_caches contract
     return mode == "1" and enabled()
 
 
@@ -413,7 +416,8 @@ _HASH_IB = 1024                # items per sequential grid step
 
 
 def hash_mode() -> str:
-    return os.environ.get("COMBBLAS_TPU_PALLAS_HASH", "")
+    # trace-time kernel selector; flips require jax.clear_caches()
+    return os.environ.get("COMBBLAS_TPU_PALLAS_HASH", "")  # analysis: allow(env-in-trace)
 
 
 def hash_enabled() -> bool:
@@ -421,7 +425,7 @@ def hash_enabled() -> bool:
     anywhere under =interpret (tests); COMBBLAS_TPU_PALLAS=0 vetoes."""
     mode = hash_mode()
     if mode == "interpret":
-        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"
+        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"  # analysis: allow(env-in-trace) same clear_caches contract
     return mode == "1" and enabled()
 
 

@@ -67,7 +67,7 @@ def _benes_masks_py(perm: np.ndarray) -> np.ndarray:
     def set_bit(t, i):
         masks[t, i >> 5] |= np.uint32(1 << (i & 31))
 
-    cur = np.array(perm, np.int64)
+    cur = np.array(perm, np.int64)  # analysis: allow(sync-in-async) host mask planning, route built once
     for d in range(m - 1):
         nn = n >> d
         h = nn >> 1
@@ -152,7 +152,7 @@ def plan_route_masks(perm: np.ndarray) -> tuple[np.ndarray, int, int]:
     (rather than `plan_route`) when the caller device_puts the masks
     itself — e.g. sharded across a mesh — so they are never staged on
     the default device."""
-    perm = np.asarray(perm, np.int32)
+    perm = np.asarray(perm, np.int32)  # analysis: allow(sync-in-async) host mask planning, route built once
     n = int(perm.shape[0])
     if n < 2:
         raise ValueError("route needs at least 2 slots")

@@ -188,8 +188,9 @@ def _unsortable(vals: Array, restore) -> Array:
 # this repo) or the 2-key reference path (fused_key_info -> None).
 
 def fused_keys_enabled() -> bool:
-    """Env opt-out: COMBBLAS_TPU_FUSED_KEY=0 forces the 2-key sorts."""
-    return os.environ.get("COMBBLAS_TPU_FUSED_KEY", "") != "0"
+    """Env opt-out: COMBBLAS_TPU_FUSED_KEY=0 forces the 2-key sorts.
+    Trace-time read by design; flips require jax.clear_caches()."""
+    return os.environ.get("COMBBLAS_TPU_FUSED_KEY", "") != "0"  # analysis: allow(env-in-trace)
 
 
 def fused_key_info(nrows: int, ncols: int, width: Optional[int] = None):

@@ -665,12 +665,15 @@ class GraphService:
         parents = mv.to_global()              # blocks on readback
         wall = time.monotonic() - t0
         self._count_dispatch("bfs")
-        lvl = np.asarray(lvl)
+        with obs.ledger.readback("serve.bfs_readback",
+                                 4 * int(np.size(lvl))
+                                 + int(np.size(done))):
+            lvl = np.asarray(lvl)
+            done = np.asarray(done)
         # bits path: per-lane level counts; dense path: one scalar wave
         # count. The EWMA tracks the wave (max), each result reports
         # its own lane.
         levels = int(lvl.max()) if lvl.ndim else int(lvl)
-        done = np.asarray(done)
         if levels > 0:
             self._bfs_level_est = (0.7 * self._bfs_level_est
                                    + 0.3 * wall / levels)
@@ -712,7 +715,7 @@ class GraphService:
 
         def build():
             self._annotate_plan(_plan_name(key), "cc", bucket)
-            return jax.jit(lambda lab, ix: lab[ix])
+            return jax.jit(lambda lab, ix: lab[ix])  # analysis: allow(cache-key-unstable) built once per PlanKey, PlanCache-cached
         return self.plans.get_or_build(key, build)
 
     def _run_cc(self, batch: Batch) -> None:
@@ -722,7 +725,9 @@ class GraphService:
         verts_p = self._pad(verts, batch.bucket)
         fn = self._cc_plan(batch.bucket)
         t0 = time.monotonic()
-        out = np.asarray(fn(labels, jnp.asarray(verts_p)))
+        out_dev = fn(labels, jnp.asarray(verts_p))
+        with obs.ledger.readback("serve.cc_readback", 4 * len(verts_p)):
+            out = np.asarray(out_dev)
         self._update_cost("cc", time.monotonic() - t0)
         self._count_dispatch("cc")
         for k, r in enumerate(reqs):
@@ -741,7 +746,7 @@ class GraphService:
             # A's tiles stay put (densemat.spmm_tall)
             tall = grid.pr == grid.pc and self.a.tile_m == tn
 
-            @partial(jax.jit)
+            @partial(jax.jit)  # analysis: allow(cache-key-unstable) built once per PlanKey, PlanCache-cached
             def run(a, arr):                  # arr: (glen, W)
                 if tall:
                     data = jnp.pad(
@@ -758,7 +763,11 @@ class GraphService:
                 return dmm.spmm(sr, a, x).data
 
             def call(arr):
-                y = np.asarray(run(self.a, jnp.asarray(arr, sr.dtype)))
+                y_dev = run(self.a, jnp.asarray(arr, sr.dtype))
+                with obs.ledger.readback(
+                        "serve.spmv_readback",
+                        int(y_dev.size) * y_dev.dtype.itemsize):
+                    y = np.asarray(y_dev)
                 return y.reshape(-1, arr.shape[1])[:nrows]
             return call
         return self.plans.get_or_build(key, build)

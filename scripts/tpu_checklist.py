@@ -65,19 +65,54 @@ def step(name):
 
 
 def run_analysis_gate() -> bool:
-    """Step 0: the static gate, isolated in its own (CPU) process."""
+    """Step 0: the static gate, isolated in its own (CPU) process.
+    Diffs the fresh waiver census against the committed
+    ANALYSIS_GATE.json — waiver growth is a posture change that should
+    land deliberately, not ride along silently."""
     step("0. static-analysis gate (CPU subprocess)")
     repo = pathlib.Path(__file__).resolve().parents[1]
+    committed = None
+    gate_path = repo / "ANALYSIS_GATE.json"
+    if gate_path.exists():
+        try:
+            committed = json.loads(gate_path.read_text())
+        except ValueError:
+            print("committed ANALYSIS_GATE.json unreadable — "
+                  "regenerate with scripts/analyze.py --gate")
     env = dict(os.environ)
     # let analyze.py pick its own CPU backend even under the tunnel
     env.pop("JAX_PLATFORMS", None)
+    fresh_path = repo / "ANALYSIS_GATE.fresh.json"
     r = subprocess.run([sys.executable, str(repo / "scripts/analyze.py"),
-                        "--gate"], env=env)
+                        "--gate", "--out", str(fresh_path)], env=env)
     if r.returncode != 0:
         print("static-analysis gate FAILED — fix (or explicitly "
               "suppress) the findings above before spending chip time",
               flush=True)
-    return r.returncode == 0
+    ok = r.returncode == 0
+    try:
+        fresh = json.loads(fresh_path.read_text())
+    except (OSError, ValueError):
+        fresh = None
+    finally:
+        fresh_path.unlink(missing_ok=True)
+    if fresh is not None:
+        n = fresh["waivers"]["source_comments"]
+        was = (committed or {}).get("waivers", {}).get("source_comments")
+        if committed is None:
+            print(f"waivers: {n} (no committed ANALYSIS_GATE.json — "
+                  f"run scripts/analyze.py --gate and commit it)")
+        elif n == was:
+            print(f"waivers: {n} (unchanged)")
+        elif n > was:
+            print(f"waivers: {n} (was {was} — GREW by {n - was}; "
+                  f"recommit ANALYSIS_GATE.json only if each new "
+                  f"waiver carries a justification)", flush=True)
+            ok = False
+        else:
+            print(f"waivers: {n} (was {was} — shrank; recommit "
+                  f"ANALYSIS_GATE.json to lock in the lower count)")
+    return ok
 
 
 def run_obs_check(grid) -> bool:

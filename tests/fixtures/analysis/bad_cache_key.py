@@ -1,0 +1,38 @@
+"""Bad-pattern fixture: unstable jit cache keys (cache-key-unstable),
+all three arms — a per-call `jax.jit` built inside a function body, a
+traced function closing over a module-level mutable the module also
+mutates, and literal lambdas/lists passed in declared static
+positions (a fresh cache key per call)."""
+
+import jax
+import jax.numpy as jnp
+
+THRESHOLDS = {"dense": 0.5}          # mutable module global ...
+
+
+def tune(v):
+    THRESHOLDS["dense"] = v          # ... mutated here
+
+
+@jax.jit
+def kernel(x):
+    # trace-time snapshot of a mutated global: silent stale answer
+    return jnp.where(x > THRESHOLDS["dense"], x, 0.0)   # fires
+
+
+def dispatch(x):
+    # fresh compile cache minted per call
+    f = jax.jit(lambda v: v * 2)     # fires
+    return f(x)
+
+
+def combine(x, fn):
+    return fn(x)
+
+
+combine_j = jax.jit(combine, static_argnums=(1,))
+
+
+def caller(x):
+    # literal lambda in a static position: new cache key every call
+    return combine_j(x, lambda v: v + 1)                # fires

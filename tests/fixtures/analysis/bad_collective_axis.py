@@ -1,0 +1,43 @@
+"""Bad-pattern fixture: mesh-collective misuse inside shard_map
+bodies (collective-axis / collective-transpose) on a rectangular
+mesh. The axis vocabulary and declared transpose pairs come from
+bad_trace_budget.json (vocabulary: r, c)."""
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+ROW_AXIS = "r"
+COL_AXIS = "c"
+
+
+def row_reduce(mesh, x):
+    def f(xb):
+        return lax.psum(xb, "q")                  # unknown axis: fires
+
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(ROW_AXIS, None),),
+                         out_specs=P(ROW_AXIS, None))(x)
+
+
+def col_sum_wrong_spec(mesh, x):
+    def f(xb):
+        # collective over "c" but the specs only declare "r": on a mesh
+        # sliced without "c" this hangs or silently misreduces
+        return lax.psum(xb, COL_AXIS)             # spec mismatch: fires
+
+    return jax.shard_map(f, mesh=mesh, in_specs=(P(ROW_AXIS, None),),
+                         out_specs=P(ROW_AXIS, None))(x)
+
+
+def undeclared_transpose(mesh, x, pr, pc):
+    tperm = [(i * pc + j, j * pc + i)
+             for i in range(pr) for j in range(pc)]
+
+    def f(xb):
+        # square-mesh transpose pairing NOT declared in the budget's
+        # transpose_pairs: silently misroutes on rectangular meshes
+        return lax.ppermute(xb, (ROW_AXIS, COL_AXIS), tperm)   # fires
+
+    return jax.shard_map(
+        f, mesh=mesh, in_specs=(P(ROW_AXIS, COL_AXIS),),
+        out_specs=P(ROW_AXIS, COL_AXIS))(x)

@@ -1,0 +1,35 @@
+"""Bad-pattern fixture: the exact PR-8 bug shape (env-in-trace). A
+jitted kernel resolves an env flag INSIDE the trace via a helper two
+calls deep — the flag is baked into the first compiled executable and
+later flips silently reuse it."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def variant_enabled() -> bool:
+    # read at trace time through kernel -> pick_variant -> here
+    return os.environ.get("FIXTURE_VARIANT", "") == "1"     # fires
+
+
+def pick_variant(x):
+    if variant_enabled():
+        return x * 2
+    return x + 1
+
+
+@jax.jit
+def kernel(x):
+    return pick_variant(jnp.sin(x))
+
+
+def also_direct(x):
+    # direct read inside a function passed to lax control flow
+    return jax.lax.cond(
+        x.sum() > 0, branch_env, lambda v: v, x)
+
+
+def branch_env(v):
+    return v * float(os.environ.get("FIXTURE_SCALE", "1"))  # fires

@@ -1,0 +1,28 @@
+"""Bad-pattern fixture: blocking host syncs on a registered async hot
+path (sync-in-async). `hot_loop` is declared an async root in
+bad_trace_budget.json; every unsanctioned sync below must fire, the
+ledger-bracketed one and the explicitly waived one must not."""
+
+import numpy as np
+
+from combblas_tpu import obs
+
+
+def hot_loop(arrs, nnz_ref):
+    total = 0
+    for a in arrs:
+        n = nnz_ref.item()                        # line 14: fires
+        host = np.asarray(a)                      # line 15: fires
+        total += helper(a) + n + int(host.sum())
+    if nnz_ref.nnz:                               # implicit __bool__: fires
+        total += 1
+    with obs.ledger.readback("fixture.nnz", 4):
+        total += int(np.asarray(nnz_ref))         # sanctioned: silent
+    waived = nnz_ref.item()  # analysis: allow(sync-in-async) fixture waiver
+    return total + waived
+
+
+def helper(a):
+    # reached interprocedurally from the root — still on the hot path
+    a.block_until_ready()                         # fires
+    return 0

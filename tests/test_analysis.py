@@ -1,4 +1,4 @@
-"""Static-analysis gate (combblas_tpu.analysis): the five passes run
+"""Static-analysis gate (combblas_tpu.analysis): the seven passes run
 clean on the merged tree, each rule demonstrably FIRES on its
 committed bad-pattern fixture under tests/fixtures/analysis/, and the
 retrace signature model agrees with jax's actual compile behavior.
@@ -17,7 +17,8 @@ import pytest
 
 from combblas_tpu import analysis
 from combblas_tpu.analysis import (budget, core, entries, hlo, lockorder,
-                                   obsbudget, perfgate, retrace)
+                                   obsbudget, perfgate, retrace,
+                                   tracehazard)
 
 pytestmark = pytest.mark.quick
 
@@ -241,6 +242,191 @@ def test_pr4_deadlock_shape_is_seen_and_deliberately_waived():
 
 
 # ---------------------------------------------------------------------------
+# pass 7: trace-hazard & collective-safety
+# ---------------------------------------------------------------------------
+
+TRACE_BUDGET = FIXTURES / "bad_trace_budget.json"
+
+
+def test_tracehazard_pass_clean_on_tree():
+    """Zero unsuppressed pass-7 findings on the merged tree: every
+    blocking sync on an async hot path is ledger-bracketed or waived,
+    every in-trace env read and unstable jit cache key carries a
+    justification, and every shard_map collective uses declared axes."""
+    fs = tracehazard.run_tracehazard()
+    assert not fs, _fmt(fs)
+
+
+def test_env_in_trace_fixture_caught_by_name():
+    """The PR-8 bug shape: an os.environ read reachable from a jitted
+    function — caught at file:line with the env-in-trace rule id."""
+    fs = tracehazard.run_tracehazard(
+        paths=[FIXTURES / "bad_env_in_trace.py"], budget_file=TRACE_BUDGET)
+    envs = [f for f in fs if f.rule == core.ENV_IN_TRACE]
+    assert len(envs) == 2, _fmt(fs)
+    assert all(f.file.endswith("bad_env_in_trace.py") for f in envs)
+    # the jit-chain arm anchors to the environ read inside
+    # variant_enabled (fixture line 14); the other to the lax.cond arm
+    assert {f.line for f in envs} == {14, 35}, _fmt(envs)
+
+
+def test_sync_in_async_fixture_fires_and_sanctioned_paths_silent():
+    fs = tracehazard.run_tracehazard(
+        paths=[FIXTURES / "bad_sync_in_async.py"], budget_file=TRACE_BUDGET)
+    syncs = [f for f in fs if f.rule == core.SYNC_IN_ASYNC]
+    # .item() (line 14), np.asarray (15), implicit __bool__ (17), and
+    # the interprocedural block_until_ready in helper (27) fire; the
+    # obs.ledger.readback-bracketed sync and the waived .item() do not
+    assert {f.line for f in syncs} == {14, 15, 17, 27}, _fmt(fs)
+    # the stale root declared in the fixture budget fires too,
+    # anchored inside the budget json
+    stale = [f for f in fs if f.rule == core.TRACE_STALE]
+    assert any(f.file.endswith("bad_trace_budget.json") for f in stale)
+
+
+def test_cache_key_fixture_fires_all_three_arms():
+    fs = tracehazard.run_tracehazard(
+        paths=[FIXTURES / "bad_cache_key.py"], budget_file=TRACE_BUDGET)
+    keys = [f for f in fs if f.rule == core.CACHE_KEY_UNSTABLE]
+    # mutated-global closure (line 20), per-call jax.jit (25),
+    # literal lambda in a static position (38)
+    assert {f.line for f in keys} == {20, 25, 38}, _fmt(fs)
+
+
+def test_collective_axis_fixture_caught_by_name():
+    """Rectangular-mesh misuse: psum over an axis outside the declared
+    vocabulary, psum over an axis the specs never mention, and a
+    transpose-style ppermute pair absent from the budget's
+    transpose_pairs — each at file:line with its rule id."""
+    fs = tracehazard.run_tracehazard(
+        paths=[FIXTURES / "bad_collective_axis.py"],
+        budget_file=TRACE_BUDGET)
+    axes = [f for f in fs if f.rule == core.COLLECTIVE_AXIS]
+    trans = [f for f in fs if f.rule == core.COLLECTIVE_TRANSPOSE]
+    assert {f.line for f in axes} == {16, 26}, _fmt(fs)
+    assert [f.line for f in trans] == [39], _fmt(fs)
+    assert all(f.file.endswith("bad_collective_axis.py")
+               for f in axes + trans)
+    # the stale transpose_pairs entry (vanished_exchange) fires in the
+    # fixture budget itself
+    stale = [f for f in fs if f.rule == core.TRACE_STALE]
+    assert any("vanished_exchange" in f.message for f in stale), _fmt(fs)
+
+
+def test_synthetic_item_in_window_loop_caught(tmp_path):
+    """Inject a blocking .item() into the real async window loop
+    (_windows_async) and run pass 7 with the real committed budget:
+    the new sync must be caught at its exact line while the file's
+    committed plan-time waivers keep holding."""
+    src = (REPO / "combblas_tpu" / "parallel" / "spgemm.py").read_text()
+    lines = src.splitlines(keepends=True)
+    anchor = next(i for i, ln in enumerate(lines)
+                  if "hook_meta = (a.grid, a.nrows, b.ncols)" in ln)
+    lines.insert(anchor + 1, "    _probe = a.nnz.item()\n")
+    injected_line = anchor + 2          # 1-indexed
+    # parent dir named "parallel" so the module resolves as
+    # parallel.spgemm and suffix-matches the budget's async root
+    pkg = tmp_path / "parallel"
+    pkg.mkdir()
+    (pkg / "spgemm.py").write_text("".join(lines))
+    fs = tracehazard.run_tracehazard(paths=[pkg / "spgemm.py"])
+    syncs = [f for f in fs if f.rule == core.SYNC_IN_ASYNC]
+    assert [f.line for f in syncs] == [injected_line], _fmt(fs)
+    assert syncs[0].file.endswith("spgemm.py")
+    assert "item" in syncs[0].message
+
+
+def test_bfs_mesh_collectives_green_static():
+    """The real bits-BFS mesh bodies pass the collective-safety check
+    against the committed budget: axes in vocabulary, specs declare
+    them, and both transpose pairings are declared transpose_pairs."""
+    fs = tracehazard.run_tracehazard(
+        paths=[REPO / "combblas_tpu" / "models" / "bfs.py"])
+    bad = [f for f in fs if f.rule in (core.COLLECTIVE_AXIS,
+                                       core.COLLECTIVE_TRANSPOSE)]
+    assert not bad, _fmt(bad)
+
+
+def test_bfs_mesh_collectives_green_jaxpr(grid22_analysis):
+    """Dynamic arm of the green test: trace the real
+    bfs_batch_bits_mesh on a routed 2x2 mesh and check every
+    collective axis in the jaxpr against the budget's declared
+    vocabulary."""
+    import json as _json
+
+    import jax
+    import numpy as np
+
+    from combblas_tpu.models import bfs as B
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as DM
+
+    grid = grid22_analysis
+    r, c = generate.rmat_edges(jax.random.key(0), 8, 8)
+    r, c = generate.symmetrize(r, c)
+    a = DM.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones_like(r, jnp.bool_), 256, 256)
+    plan = B.plan_bfs(a, route=True)
+    assert B.bits_fallback_reason(a, plan) is None
+    roots = np.arange(8, dtype=np.int32)
+    jaxpr = jax.make_jaxpr(
+        lambda: B.bfs_batch_bits_mesh(a, roots, plan=plan)[1])()
+    axes = tracehazard.jaxpr_collective_axes(jaxpr)
+    vocab = set(_json.loads(
+        (REPO / "combblas_tpu" / "analysis" / "budgets" /
+         "trace_hazard.json").read_text())["axis_vocabulary"])
+    assert axes, "expected collectives in the mesh BFS jaxpr"
+    assert axes <= vocab, f"undeclared axes {axes - vocab}"
+
+
+@pytest.fixture(scope="module")
+def grid22_analysis():
+    import jax
+
+    from combblas_tpu.parallel.distmat import ProcGrid
+    return ProcGrid.make(2, 2, jax.devices()[:4])
+
+
+def test_raw_analyzer_still_sees_waived_sites():
+    """Regression guard for the waiver sweep: deleting the detection
+    (instead of carrying the justified waivers) must break this test.
+    The RAW analyzer — no suppression filtering — still reports the
+    plan-time syncs in spgemm/bfs, the sanctioned env selectors in
+    pallas_kernels/tile, and the per-plan jit cache keys."""
+    pkg = REPO / "combblas_tpu"
+    raw = tracehazard.Analyzer([pkg]).run()
+    by_rule = {}
+    for f in raw:
+        by_rule.setdefault(f.rule, []).append(f)
+    syncs = by_rule.get(core.SYNC_IN_ASYNC, [])
+    envs = by_rule.get(core.ENV_IN_TRACE, [])
+    keys = by_rule.get(core.CACHE_KEY_UNSTABLE, [])
+    assert len(syncs) >= 15, _fmt(syncs)
+    assert len(envs) >= 6, _fmt(envs)
+    assert len(keys) >= 6, _fmt(keys)
+    assert any(f.file.endswith("parallel/spgemm.py") for f in syncs)
+    assert any(f.file.endswith("ops/pallas_kernels.py") for f in envs)
+    assert any(f.file.endswith("analysis/retrace.py") for f in keys)
+    # ... while the filtered run stays clean (the waivers hold)
+    assert not tracehazard.run_tracehazard()
+
+
+def test_with_scope_suppression_covers_any_rule():
+    """The block-scope half of the suppression contract, hoisted into
+    core.FileSuppressions: an allow() on a `with` line covers findings
+    anywhere in its block — for any rule, not just the lock lint."""
+    src = ("def f(x):\n"
+           "    with ctx():  # analysis: allow(sync-in-async)\n"
+           "        a = 1\n"
+           "        x.item()\n")
+    sup = core.FileSuppressions(src)
+    hit = core.Finding(core.SYNC_IN_ASYNC, "f.py", 4, "m")
+    assert sup.covers(hit)
+    other = core.Finding(core.ENV_IN_TRACE, "f.py", 4, "m")
+    assert not sup.covers(other)
+
+
+# ---------------------------------------------------------------------------
 # suppression mechanics
 # ---------------------------------------------------------------------------
 
@@ -295,7 +481,7 @@ def test_bits_ladder_folds_to_one_signature():
 
 def test_run_all_selected_passes_clean():
     assert analysis.run_all(passes=("retrace", "locks", "obs",
-                                    "perf")) == []
+                                    "perf", "trace")) == []
 
 
 def test_cli_gate_exit_codes():
@@ -305,7 +491,57 @@ def test_cli_gate_exit_codes():
     finds violations (driven via the self-test fixtures)."""
     r = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "analyze.py"),
-         "--gate", "--passes", "locks,retrace,obs,perf"],
+         "--gate", "--passes", "locks,retrace,obs,perf,trace"],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "PASS" in r.stdout
+
+
+def test_cli_diff_mode_filters_to_changed_files():
+    """`--diff REV` runs the AST passes whole-tree but reports only
+    findings in files changed since REV — with HEAD on a clean tree
+    that is zero findings and exit 0, in seconds."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "analyze.py"),
+         "--diff", "HEAD"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "analyze --diff HEAD" in r.stdout
+
+
+def test_gate_report_structure_and_committed_copy(tmp_path):
+    """ANALYSIS_GATE.json: per-pass counts + waiver census, emitted
+    deterministically. The committed copy must agree with a fresh
+    census (waiver growth lands deliberately, via regenerating the
+    file), and the census must not count doc examples of the waiver
+    syntax as waivers."""
+    import importlib.util
+    import json
+
+    spec = importlib.util.spec_from_file_location(
+        "analyze_mod", REPO / "scripts" / "analyze.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    census = mod.waiver_census()
+    assert set(census) == {"source_comments", "by_rule", "budget_allows"}
+    assert all(r in core.ALL_RULES or r == "*" for r in census["by_rule"])
+    assert census["source_comments"] == sum(census["by_rule"].values())
+    # the pass-7 sweep's waivers are present by rule id
+    assert census["by_rule"].get(core.SYNC_IN_ASYNC, 0) >= 10
+    assert census["by_rule"].get(core.ENV_IN_TRACE, 0) >= 6
+    assert census["by_rule"].get(core.CACHE_KEY_UNSTABLE, 0) >= 6
+
+    out = tmp_path / "gate.json"
+    mod.write_gate_report(out, {"trace": 0, "locks": 0}, [])
+    report = json.loads(out.read_text())
+    assert report["verdict"] == "PASS"
+    assert report["passes"]["trace"] == {"findings": 0}
+    assert report["waivers"] == census
+
+    committed = json.loads((REPO / "ANALYSIS_GATE.json").read_text())
+    assert committed["verdict"] == "PASS"
+    assert set(committed["passes"]) == set(mod.ALL_PASSES)
+    assert committed["waivers"] == census, (
+        "waiver census drifted from the committed ANALYSIS_GATE.json —"
+        " rerun scripts/analyze.py --gate and commit the result")
