@@ -1,7 +1,7 @@
 """`combblas_tpu.analysis` — static-analysis gate for the repo's
 structural invariants.
 
-Seven passes, one verdict (see `scripts/analyze.py --gate` and the
+Eight passes, one verdict (see `scripts/analyze.py --gate` and the
 README "Static analysis" section):
 
 1. **Budget engine** (`budget.run_budgets`) — lowers registered
@@ -46,6 +46,13 @@ README "Static analysis" section):
    checked against their declared mesh axes — with the square-mesh
    transpose ppermute pairings pinned in the budget so rectangular/3D
    mesh work fails loudly.
+8. **chaos-recovery budget** (`chaosbudget.run_chaos`) — committed
+   resilience invariants over the `CHAOS_r*.json` soak artifacts
+   (`budgets/chaos.json`): zero unresolved serve futures under the
+   committed fault schedule, faulted-phase shed within its ceiling,
+   bit-exact results once faults clear (serve traffic, fault-recovered
+   SpGEMM, resumed MCL), and vacuity floors on injected-fault/retry
+   counts so the soak keeps exercising the paths it gates.
 
 All passes are trace/AST/JSON only — nothing here compiles or
 executes device code — and every finding carries `file:line`, a rule
@@ -95,8 +102,13 @@ def run_tracehazard(**kw):
     return tracehazard.run_tracehazard(**kw)
 
 
+def run_chaos(**kw):
+    from combblas_tpu.analysis import chaosbudget
+    return chaosbudget.run_chaos(**kw)
+
+
 def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
-                    "mem", "trace")) -> list[Finding]:
+                    "mem", "trace", "chaos")) -> list[Finding]:
     """Run the selected passes; returns all unsuppressed findings
     (empty = gate passes)."""
     out: list[Finding] = []
@@ -114,4 +126,6 @@ def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
         out += run_mem()
     if "trace" in passes:
         out += run_tracehazard()
+    if "chaos" in passes:
+        out += run_chaos()
     return out

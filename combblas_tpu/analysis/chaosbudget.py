@@ -1,0 +1,182 @@
+"""Pass 8 — chaos-recovery budget over committed soak artifacts.
+
+`scripts/chaos_bench.py` drives the committed fault schedule
+(`scripts/chaos_schedule.json`) against a live serving workload, a
+phased SpGEMM, and an MCL checkpoint/resume pair, and records the
+outcome in a `chaos_summary` block inside `CHAOS_r*.json`. This pass
+holds that block against `analysis/budgets/chaos.json`, committing the
+resilience layer's recovery invariants the same way pass 4 commits
+attribution coverage:
+
+* **unresolved handles** — every future submitted under faults must
+  resolve (result OR error). A hang is the one outcome the worker
+  supervision layer exists to prevent; the ceiling is 0.
+* **shed budget** — the faulted phase may shed load (breaker opens,
+  predictive shed), but only up to a committed fraction. Unbounded
+  shedding under bounded faults means recovery regressed into refusal.
+* **bit-exactness** — once faults clear, the SAME service must return
+  results bit-identical to the fault-free reference, the
+  fault-recovered SpGEMM must match the clean product, and a resumed
+  solver must match its uninterrupted run. Anything else means a fault
+  leaked state (poisoned cache, stuck breaker, lost worker).
+* **recovery floors** — the soak must actually bite: a minimum number
+  of injected faults and observed retries (a soak that injected
+  nothing proves nothing), and a floor on the fraction of faulted
+  queries that still succeeded.
+* **staleness** — a budget naming an artifact or a `chaos_summary`
+  field that no longer exists is flagged rather than silently vacuous.
+
+Budget JSON shape (one file may pin several artifacts)::
+
+    {"artifacts": [{
+        "artifact": "CHAOS_r*.json",   # repo-root relative; globs pick
+                                       # newest by mtime
+        "driver": "chaos",
+        "unresolved_handles_max": 0,
+        "shed_frac_max": 0.25,
+        "require_bit_exact": true,     # serve results after clear AND
+                                       # the faulted SpGEMM product
+        "require_checkpoint_resume_exact": true,
+        "min_faults_injected": 5,
+        "min_retries": 1,
+        "recovery_frac_min": 0.75,
+        "allow": []                    # waived rule ids
+    }]}
+
+All checks are pure JSON reads — nothing here compiles or runs device
+code. A numeric check whose `chaos_summary` field is absent flags
+STALE (shape drift), never passes silently.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+from combblas_tpu.analysis.obsbudget import (
+    _line_of, _load_artifact, _resolve_artifact,
+)
+
+BUDGET_DIR = pathlib.Path(__file__).parent / "budgets"
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def check_artifact(ent: dict, budget_text: str, budget_path: str,
+                   root=None) -> list[Finding]:
+    """All findings for one budget entry (the unit the self-test
+    fixtures drive)."""
+    allow = set(ent.get("allow", []))
+    name = ent["artifact"]
+    driver = ent.get("driver", name)
+    findings: list[Finding] = []
+
+    def add(rule, key, msg):
+        if rule not in allow:
+            findings.append(Finding(
+                rule, budget_path, _line_of(budget_text, name, key),
+                msg, entry=driver))
+
+    path = _resolve_artifact(name, pathlib.Path(root or REPO_ROOT))
+    if path is None:
+        add(core.CHAOS_STALE, "artifact",
+            f"artifact {name!r} not found — run scripts/chaos_bench.py "
+            "to generate it, or drop the stale budget entry")
+        return findings
+    try:
+        art = _load_artifact(path)
+    except ValueError as e:
+        add(core.CHAOS_STALE, "artifact", f"artifact unreadable: {e}")
+        return findings
+    cs = art.get("chaos_summary")
+    if not isinstance(cs, dict):
+        add(core.CHAOS_STALE, "artifact",
+            f"{path.name}: no chaos_summary block — not a chaos soak "
+            "artifact (rerun scripts/chaos_bench.py)")
+        return findings
+
+    def field(key: str, budget_key: str):
+        """(value, present) of a summary field a budget check needs;
+        absence is shape drift and flags STALE."""
+        if key not in cs:
+            add(core.CHAOS_STALE, budget_key,
+                f"{path.name}: chaos_summary has no {key!r} field — "
+                "the artifact shape drifted from the budget")
+            return None, False
+        return cs[key], True
+
+    ceil = ent.get("unresolved_handles_max")
+    if ceil is not None:
+        v, ok = field("unresolved_handles", "unresolved_handles_max")
+        if ok and int(v) > int(ceil):
+            add(core.CHAOS_UNRESOLVED, "unresolved_handles_max",
+                f"{path.name}: {int(v)} serve future(s) never resolved "
+                f"under faults (ceiling {int(ceil)}) — the supervision "
+                "layer let a request hang")
+
+    frac_max = ent.get("shed_frac_max")
+    if frac_max is not None:
+        v, ok = field("shed_frac", "shed_frac_max")
+        if ok and float(v) > float(frac_max):
+            add(core.CHAOS_SHED, "shed_frac_max",
+                f"{path.name}: faulted-phase shed fraction "
+                f"{float(v):.1%} exceeds the committed ceiling "
+                f"{float(frac_max):.1%} — recovery regressed into "
+                "load refusal")
+
+    if ent.get("require_bit_exact"):
+        for key in ("bit_exact_after_clear", "spgemm_faulted_bit_exact"):
+            v, ok = field(key, "require_bit_exact")
+            if ok and not v:
+                add(core.CHAOS_BIT_EXACT, "require_bit_exact",
+                    f"{path.name}: {key} is false — a fault leaked "
+                    "state into post-recovery results")
+
+    if ent.get("require_checkpoint_resume_exact"):
+        v, ok = field("checkpoint_resume_exact",
+                      "require_checkpoint_resume_exact")
+        if ok and not v:
+            add(core.CHAOS_BIT_EXACT, "require_checkpoint_resume_exact",
+                f"{path.name}: checkpoint_resume_exact is false — a "
+                "resumed solver diverged from its uninterrupted run")
+
+    for key, budget_key, what in (
+            ("faults_injected", "min_faults_injected", "fault(s)"),
+            ("retries", "min_retries", "retry/retries")):
+        floor = ent.get(budget_key)
+        if floor is None:
+            continue
+        v, ok = field(key, budget_key)
+        if ok and int(v) < int(floor):
+            add(core.CHAOS_RECOVERY, budget_key,
+                f"{path.name}: only {int(v)} {what} recorded (floor "
+                f"{int(floor)}) — the soak is vacuous; it no longer "
+                "exercises the recovery paths it gates")
+
+    floor = ent.get("recovery_frac_min")
+    if floor is not None:
+        v, ok = field("recovered_frac", "recovery_frac_min")
+        if ok and float(v) < float(floor):
+            add(core.CHAOS_RECOVERY, "recovery_frac_min",
+                f"{path.name}: only {float(v):.1%} of faulted queries "
+                f"recovered (floor {float(floor):.1%}) — retry/"
+                "degradation stopped absorbing the committed schedule")
+    return findings
+
+
+def run_chaos(files=None, root=None) -> list[Finding]:
+    """Run the chaos-recovery budget pass over the committed budgets
+    (or an explicit fixture list); returns unsuppressed findings."""
+    paths = ([pathlib.Path(f) for f in files] if files is not None
+             else sorted(BUDGET_DIR.glob("chaos*.json")))
+    findings: list[Finding] = []
+    for p in paths:
+        text = p.read_text()
+        data = json.loads(text)
+        for ent in data.get("artifacts", []):
+            if "artifact" not in ent:
+                raise ValueError(f"{p}: chaos budget entry without "
+                                 "'artifact'")
+            findings += check_artifact(ent, text, str(p), root=root)
+    return findings

@@ -75,6 +75,22 @@ COLLECTIVE_TRANSPOSE = "collective-transpose"  # multi-axis ppermute (the
 TRACE_STALE = "trace-stale-budget"       # trace_hazard.json names a function
 #                                          / site that no longer exists
 
+# chaos-recovery budget over CHAOS_r*.json soak artifacts (pass 8)
+CHAOS_UNRESOLVED = "chaos-unresolved-handles"  # a serve future never
+#                                          resolved under faults — the
+#                                          hang supervision must prevent
+CHAOS_SHED = "chaos-shed-budget"         # faulted-phase shed fraction
+#                                          over the committed ceiling
+CHAOS_BIT_EXACT = "chaos-bit-exact"      # results after faults clear
+#                                          (or a resumed solver) drifted
+#                                          from the fault-free reference
+CHAOS_RECOVERY = "chaos-recovery-floor"  # the soak is vacuous (too few
+#                                          faults injected / retries) or
+#                                          recovered fraction below floor
+CHAOS_STALE = "chaos-stale-artifact"     # chaos budget names an
+#                                          artifact/summary field that
+#                                          no longer exists
+
 # memory-budget gate over bench memory_summary blocks (pass 6)
 MEM_TEMP = "mem-temp-ceiling"            # per-executable temp bytes over
 #                                          the committed ceiling
@@ -97,6 +113,8 @@ ALL_RULES = (
     MEM_TEMP, MEM_PEAK, MEM_DONATION, MEM_CENSUS, MEM_STALE,
     SYNC_IN_ASYNC, ENV_IN_TRACE, CACHE_KEY_UNSTABLE, COLLECTIVE_AXIS,
     COLLECTIVE_TRANSPOSE, TRACE_STALE,
+    CHAOS_UNRESOLVED, CHAOS_SHED, CHAOS_BIT_EXACT, CHAOS_RECOVERY,
+    CHAOS_STALE,
 )
 
 

@@ -35,7 +35,10 @@ from combblas_tpu.parallel.grid import ROW_AXIS, COL_AXIS
 _I32MAX = jnp.iinfo(jnp.int32).max
 
 
-def fastsv(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
+def fastsv(a: dm.DistSpMat, max_iters: int = 100, *,
+           checkpoint_path: str | None = None,
+           checkpoint_every: int = 0,
+           resume: bool = False) -> dvec.DistVec:
     """Component labels (min vertex id per component) of the symmetric
     graph ``a``; one jitted while_loop (≅ FastSV.h:25-377).
 
@@ -55,26 +58,36 @@ def fastsv(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
     replicated (n,) int32 — O(n) vertex state per device, fine
     through scale ~24 but contradicting the hypersparse scaling story
     above that (VERDICT r4 weak #3).
+
+    ``checkpoint_path``/``checkpoint_every``: run the CHUNKED driver
+    instead — `checkpoint_every` iterations per jitted chunk, the
+    `(f, gf)` carry persisted through `resilience.checkpoint` between
+    chunks, ``resume=True`` continuing from the newest complete
+    checkpoint. The chunked driver always runs on the replicated
+    substrate (bit-identical to the sharded one — cross-checked in
+    tests since the shard round); chunk boundaries only cut the
+    while_loop, so labels match the single-shot run exactly.
     """
     if a.nrows != a.ncols:
         raise ValueError(
             f"fastsv needs a square symmetric adjacency matrix, got "
             f"{a.nrows}x{a.ncols}")
+    if checkpoint_path and checkpoint_every:
+        return _fastsv_checkpointed(a, max_iters, checkpoint_path,
+                                    int(checkpoint_every), resume)
     if a.grid.pr == a.grid.pc and a.grid.pr > 1 and a.tile_m == a.tile_n:
         return _fastsv_sharded(a, max_iters=max_iters)
     return _fastsv_replicated(a, max_iters=max_iters)
 
 
-@partial(jax.jit, static_argnames=("max_iters",))
-def _fastsv_replicated(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
-    """Replicated-parent FastSV (see `fastsv`)."""
-    if a.nrows != a.ncols:
-        raise ValueError(
-            f"fastsv needs a square symmetric adjacency matrix, got "
-            f"{a.nrows}x{a.ncols}")
+def _replicated_fns(a: dm.DistSpMat, max_iters: int):
+    """The replicated-parent iteration as (body, cond) while_loop fns
+    over carry (f, gf, it, changed) — shared by the single-shot
+    `_fastsv_replicated` and the chunked checkpoint driver so the two
+    trace literally the same math."""
     n = a.nrows
     grid = a.grid
-    tile_n, tile_m = a.tile_n, a.tile_m
+    tile_n = a.tile_n
     cpad = grid.pc * tile_n - n
 
     def to_cvec(flat):
@@ -104,15 +117,76 @@ def _fastsv_replicated(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
         _, _, it, changed = carry
         return changed & (it < max_iters)
 
+    return body, cond
+
+
+def _emit_rvec(a: dm.DistSpMat, f) -> dvec.DistVec:
+    """Final full path compression + row-axis DistVec emission (shared
+    tail of the replicated paths: f is within one jump of the root at
+    convergence; one more composition makes labels exact roots)."""
+    n = a.nrows
+    f = f[jnp.clip(f, 0, n - 1)]
+    rpad = a.grid.pr * a.tile_m - n
+    data = jnp.pad(f, (0, rpad), constant_values=_I32MAX)
+    return dvec.DistVec(data.reshape(a.grid.pr, a.tile_m), a.grid,
+                        ROW_AXIS, n)
+
+
+@partial(jax.jit, static_argnames=("max_iters",))
+def _fastsv_replicated(a: dm.DistSpMat, max_iters: int = 100) -> dvec.DistVec:
+    """Replicated-parent FastSV (see `fastsv`)."""
+    if a.nrows != a.ncols:
+        raise ValueError(
+            f"fastsv needs a square symmetric adjacency matrix, got "
+            f"{a.nrows}x{a.ncols}")
+    n = a.nrows
+    body, cond = _replicated_fns(a, max_iters)
     f0 = jnp.arange(n, dtype=jnp.int32)
     f, _, _, _ = lax.while_loop(cond, body,
                                 (f0, f0, jnp.int32(0), jnp.bool_(True)))
-    # final full path compression (f is within one jump of the root at
-    # convergence; one more composition makes labels exact roots)
-    f = f[jnp.clip(f, 0, n - 1)]
-    rpad = grid.pr * tile_m - n
-    data = jnp.pad(f, (0, rpad), constant_values=_I32MAX)
-    return dvec.DistVec(data.reshape(grid.pr, tile_m), grid, ROW_AXIS, n)
+    return _emit_rvec(a, f)
+
+
+@partial(jax.jit, static_argnames=("max_iters",))
+def _fastsv_chunk(a: dm.DistSpMat, f, gf, max_iters: int):
+    """Up to `max_iters` replicated FastSV iterations from an arbitrary
+    (f, gf) carry: the chunked checkpoint driver's unit of device work.
+    Returns (f, gf, iters_done, changed) — NO final compression (the
+    carry must round-trip a checkpoint byte-exactly)."""
+    body, cond = _replicated_fns(a, max_iters)
+    return lax.while_loop(cond, body,
+                          (f, gf, jnp.int32(0), jnp.bool_(True)))
+
+
+def _fastsv_checkpointed(a, max_iters, path, every, resume):
+    """Chunked FastSV with persisted carry (see `fastsv`)."""
+    from combblas_tpu.resilience import checkpoint as ckpt_mod
+    n = a.nrows
+    grid = a.grid
+    it_done = 0
+    f = gf = None
+    if resume:
+        meta = ckpt_mod.read_meta(path)
+        if meta is not None and meta.get("solver") == "fastsv":
+            with obs.span("fastsv_resume", category="host_readback"):
+                f, gf, meta = ckpt_mod.load_fastsv(grid, path)
+            it_done = int(meta.get("it", 0))
+    if f is None:
+        f = jnp.arange(n, dtype=jnp.int32)
+        gf = f
+    changed = True
+    while changed and it_done < max_iters:
+        k = min(every, max_iters - it_done)
+        f, gf, dit, ch = _fastsv_chunk(a, f, gf, max_iters=k)
+        with obs.ledger.readback("cc.chunk_readback", 8):
+            it_done += int(np.asarray(dit))
+            changed = bool(np.asarray(ch))
+        if changed and it_done < max_iters:
+            with obs.span("fastsv_checkpoint", category="host_readback"), \
+                    obs.ledger.readback("cc.checkpoint", 8 * n):
+                ckpt_mod.save_fastsv(path, grid, f, gf,
+                                     it=it_done, glen=n)
+    return _emit_rvec(a, f)
 
 
 @partial(jax.jit, static_argnames=("max_iters",))
@@ -282,6 +356,8 @@ _fastsv_replicated = obs.instrument(
     _fastsv_replicated, "cc.fastsv_replicated", sync=True)
 _fastsv_sharded = obs.instrument(
     _fastsv_sharded, "cc.fastsv_sharded", sync=True)
+_fastsv_chunk = obs.instrument(
+    _fastsv_chunk, "cc.fastsv_chunk", sync=True)
 
 
 @partial(jax.jit, static_argnames=("max_iters",))

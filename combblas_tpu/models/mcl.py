@@ -272,7 +272,10 @@ def _select_thr(need, packed):
 
 def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
         verbose: bool = False,
-        cap_ladder: Optional[spg.CapLadder] = None,
+        cap_ladder: Optional[spg.CapLadder] = None, *,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every: int = 0,
+        resume: bool = False,
         ) -> tuple[dv.DistVec, int, int]:
     """Cluster the graph ``a`` (≅ HipMCL, MCL.cpp:515). Returns
     (cluster labels r-aligned, #clusters, #iterations).
@@ -286,11 +289,25 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
     of a previous run's rungs) — a warm ladder mints zero new rungs,
     so a repeat run re-traces/re-compiles zero expansion shapes. The
     ladder is mutated in place; callers can `save()` it afterwards.
+
+    ``checkpoint_path``/``checkpoint_every``: persist the loop carry
+    (iterated matrix, pinned capacity, ladder rungs, iteration count)
+    through `resilience.checkpoint` every N iterations, at the loop
+    head — exactly the state the loop holds entering iteration `it`.
+    ``resume=True`` restarts from the newest complete checkpoint at
+    the path (skipping setup); a resumed run walks the same iteration
+    sequence as the uninterrupted one, so labels, cluster count and
+    total iterations match. No complete checkpoint -> cold start.
     """
     if a.nrows != a.ncols:
         raise ValueError("mcl needs a square adjacency matrix")
+    if checkpoint_every and not checkpoint_path:
+        raise ValueError("checkpoint_every needs a checkpoint_path")
     with obs.span("mcl"):
-        return _mcl_instrumented(a, params, verbose, cap_ladder)
+        return _mcl_instrumented(a, params, verbose, cap_ladder,
+                                 checkpoint_path=checkpoint_path,
+                                 checkpoint_every=checkpoint_every,
+                                 resume=resume)
 
 
 #: per-nnz (flops, local bytes) models for the mcl.* ledger names —
@@ -316,44 +333,88 @@ def _annotate_mcl_costs(nnz: int) -> None:
     obs.costmodel.annotate("mcl.chaos_deferred", lbytes=4.0)
 
 
-def _mcl_instrumented(a, params, verbose, cap_ladder=None):
+def _mcl_instrumented(a, params, verbose, cap_ladder=None, *,
+                      checkpoint_path=None, checkpoint_every=0,
+                      resume=False):
+    from combblas_tpu.resilience import checkpoint as ckpt_mod
     # span taxonomy per iteration (≅ MCL.cpp's printed per-iteration
     # stats): `mcl_expand` is structural — its children are the phased
     # SpGEMM driver's plan/window/sort spans plus the cap-pin readback
     # — so the expansion's dispatch/readback glue (the round-5 63%
     # mystery) shows up as named categories + an explicit residual
-    with obs.span("mcl_setup", category="device_execute"):
-        a = a.astype(jnp.float32)
-        a = alg.add_loops(a, 1.0)
-        a = make_col_stochastic(a)
-        obs.sync(a.vals)
-    _annotate_mcl_costs(a.getnnz())
-    hook = partial(mcl_prune_select_recover, p=params)
-    nproc = a.grid.pr * a.grid.pc
+    grid = a.grid
+    nproc = grid.pr * grid.pc
     # ONE capacity ladder for the whole run: iteration 1 (the largest —
     # prune shrinks nnz monotonically) mints the rungs; iterations 2..N
     # reuse them and hit the jit cache (VERDICT r4 missing #1: the
     # round-4 run spent ~90% of 2117 s in per-iteration recompiles)
     ladder = spg.CapLadder() if cap_ladder is None else cap_ladder
-    if spg.sync_windows_enabled():
-        a, it = _mcl_loop_sync(a, params, verbose, hook, ladder, nproc)
+    it0 = 0
+    cap_pin0 = None
+    meta = (ckpt_mod.read_meta(checkpoint_path)
+            if resume and checkpoint_path else None)
+    if meta is not None and meta.get("solver") == "mcl":
+        # resume: the checkpointed matrix IS the post-setup loop carry
+        # entering iteration `it` — skip setup, re-seed the ladder so
+        # every re-planned expansion lands on the original rungs
+        with obs.span("mcl_resume", category="host_readback"):
+            a, meta = ckpt_mod.load_mcl(S.PLUS, grid, checkpoint_path)
+        it0 = int(meta.get("it", 0))
+        cap_pin0 = meta.get("cap_pin")
+        for r in meta.get("rungs", []):
+            if int(r) not in ladder.rungs:
+                ladder.rungs.append(int(r))
+        ladder.rungs.sort()
     else:
-        a, it = _mcl_loop_fused(a, params, verbose, hook, ladder, nproc)
+        with obs.span("mcl_setup", category="device_execute"):
+            a = a.astype(jnp.float32)
+            a = alg.add_loops(a, 1.0)
+            a = make_col_stochastic(a)
+            obs.sync(a.vals)
+    _annotate_mcl_costs(a.getnnz())
+    hook = partial(mcl_prune_select_recover, p=params)
+    ckpt = ((checkpoint_path, int(checkpoint_every), ladder)
+            if checkpoint_path and checkpoint_every else None)
+    if spg.sync_windows_enabled():
+        a, it = _mcl_loop_sync(a, params, verbose, hook, ladder, nproc,
+                               ckpt=ckpt, it0=it0, cap_pin0=cap_pin0)
+    else:
+        a, it = _mcl_loop_fused(a, params, verbose, hook, ladder, nproc,
+                                ckpt=ckpt, it0=it0, cap_pin0=cap_pin0)
     with obs.span("mcl_interpret", category="device_execute"):
         labels, nclusters = interpret(a)
         obs.sync(labels.data)
     return labels, nclusters, it
 
 
-def _mcl_loop_sync(a, params, verbose, hook, ladder, nproc):
+def _maybe_checkpoint(ckpt, a, cap_pin, it, it0) -> None:
+    """Loop-head checkpoint: persists (a, cap_pin, it) when the cadence
+    lands on `it` (skipping the iteration we just resumed at — nothing
+    new to say). The matrix fetch is a blocking host readback, so it is
+    declared to the dispatch ledger like any other sync point."""
+    if ckpt is None:
+        return
+    path, every, ladder = ckpt
+    if it <= it0 or it % every != 0:
+        return
+    from combblas_tpu.resilience import checkpoint as ckpt_mod
+    with obs.span("mcl_checkpoint", category="host_readback"), \
+            obs.ledger.readback("mcl.checkpoint", int(a.cap) * 12):
+        ckpt_mod.save_mcl(path, a, it=it, cap_pin=cap_pin,
+                          rungs=ladder.rungs)
+
+
+def _mcl_loop_sync(a, params, verbose, hook, ladder, nproc, *,
+                   ckpt=None, it0=0, cap_pin0=None):
     """The r05 unfused reference loop (COMBBLAS_TPU_SYNC_WINDOWS=1):
     separate repin/inflate/chaos dispatches, blocking chaos readback
     every iteration. Kept as the fused mega-step's bit-exactness
     oracle (same env var gates the blocking window loop underneath)."""
     ch = float("inf")
-    it = 0
-    cap_pin = None
+    it = it0
+    cap_pin = cap_pin0
     while ch > params.chaos_eps and it < params.max_iters:
+        _maybe_checkpoint(ckpt, a, cap_pin, it, it0)
         with obs.span("mcl_expand", it=it):
             a = spg.spgemm_phased(
                 S.PLUS_TIMES_F32, a, a, phases=params.phases,
@@ -394,7 +455,8 @@ def _resolve_chaos(pending) -> float:
         return float(np.asarray(ch_dev))
 
 
-def _mcl_loop_fused(a, params, verbose, hook, ladder, nproc):
+def _mcl_loop_fused(a, params, verbose, hook, ladder, nproc, *,
+                    ckpt=None, it0=0, cap_pin0=None):
     """The async fused loop (default since r06): one `mcl.megastep`
     dispatch replaces the repin/inflate/stochastic/chaos tail, and the
     chaos scalar is read DEFERRED — enqueued after the mega-step,
@@ -403,9 +465,15 @@ def _mcl_loop_fused(a, params, verbose, hook, ladder, nproc):
     free). Checking iteration k's chaos before iteration k+1's
     expansion is exactly the reference loop's `while ch > eps`
     ordering, so iteration counts (and everything downstream) are
-    bit-identical."""
-    it = 0
-    cap_pin = None
+    bit-identical.
+
+    Checkpoints (when armed) land at the loop head AFTER the pending
+    chaos is resolved and the continue decision is made: the persisted
+    state (a, cap_pin, it, pending=None) is byte-for-byte the state a
+    resumed loop constructs before its first expansion, which is what
+    makes resume bit-exact by construction rather than by luck."""
+    it = it0
+    cap_pin = cap_pin0
     pending = None      # (chaos device scalar, deferred ledger handle)
     while it < params.max_iters:
         if pending is not None:
@@ -416,6 +484,7 @@ def _mcl_loop_fused(a, params, verbose, hook, ladder, nproc):
                 print(f"mcl iter {it}: chaos {ch:.6f}")
             if not ch > params.chaos_eps:
                 break
+        _maybe_checkpoint(ckpt, a, cap_pin, it, it0)
         with obs.span("mcl_expand", it=it):
             a = spg.spgemm_phased(
                 S.PLUS_TIMES_F32, a, a, phases=params.phases,

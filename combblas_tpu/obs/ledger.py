@@ -51,6 +51,22 @@ from combblas_tpu.obs import trace as _trace
 
 _LEDGER_ON = True   # sub-switch: ledger active iff this AND trace._ENABLED
 
+#: chaos hook (resilience.faults.FaultInjector) — the instrument
+#: wrappers and readback brackets are the choke points every hot
+#: dispatch already flows through, so fault injection intercepts here.
+#: Disarmed cost is one module-global load + `is None` per call.
+_FAULT_HOOK = None
+
+
+def set_fault_hook(hook) -> None:
+    """Install/remove the fault-injection hook (see
+    `combblas_tpu.resilience.faults.arm`). The hook object must expose
+    `before_dispatch(name)` (may raise or sleep),
+    `after_dispatch(name, out)` (may poison the output), and
+    `stuck_readback(name)` (deferred handles that never report ready)."""
+    global _FAULT_HOOK
+    _FAULT_HOOK = hook
+
 
 def set_enabled(on: bool) -> None:
     """Arm/disarm the ledger independently of span tracing (spans may
@@ -196,6 +212,9 @@ def readback(name: str, out_bytes: int = 0,
     """Bracket a manual device->host fetch (`int(np.asarray(...))`
     sites) so it lands in the ledger as a named readback. Zero
     overhead when disabled (the flag check is the only work)."""
+    hook = _FAULT_HOOK
+    if hook is not None and _trace_clean():
+        hook.before_dispatch(name)
     if not (_LEDGER_ON and _trace._ENABLED):
         yield
         return
@@ -217,6 +236,7 @@ class _DeferredReadback:
     so there is nothing to attribute."""
 
     __slots__ = ("name", "out_bytes", "ledger", "t_enq", "_done")
+    stuck = False
 
     def __init__(self, name, out_bytes, ledger, t_enq):
         self.name = name
@@ -243,6 +263,7 @@ class _DeferredReadback:
 class _NoopDeferred:
     __slots__ = ()
     t_enq = None
+    stuck = False
 
     @contextlib.contextmanager
     def resolve(self):
@@ -252,6 +273,25 @@ class _NoopDeferred:
 _NOOP_DEFERRED = _NoopDeferred()
 
 
+class _StuckDeferred:
+    """Handle minted under an armed "stuck" fault: it never reports
+    ready, so ready-polling consumers (the phased-SpGEMM window loop)
+    must take their fallback path. `resolve()` still yields — a
+    consumer that blocks unconditionally is not the failure mode this
+    models."""
+
+    __slots__ = ()
+    t_enq = None
+    stuck = True
+
+    @contextlib.contextmanager
+    def resolve(self):
+        yield
+
+
+_STUCK_DEFERRED = _StuckDeferred()
+
+
 def readback_deferred(name: str, out_bytes: int = 0,
                       ledger: Ledger | None = None):
     """Mint a deferred-readback handle at the moment an async
@@ -259,6 +299,9 @@ def readback_deferred(name: str, out_bytes: int = 0,
     Returns a handle whose `.resolve()` context manager brackets the
     eventual blocking consumption. Zero overhead when disabled (a
     shared no-op handle)."""
+    hook = _FAULT_HOOK
+    if hook is not None and _trace_clean() and hook.stuck_readback(name):
+        return _STUCK_DEFERRED
     if not (_LEDGER_ON and _trace._ENABLED):
         return _NOOP_DEFERRED
     return _DeferredReadback(
@@ -288,14 +331,24 @@ def instrument(fn, name: str, *, kind: str = "dispatch",
     _memledger.ensure_installed()
 
     def wrapper(*args, **kwargs):
+        hook = _FAULT_HOOK
+        if hook is not None and not _trace_clean():
+            hook = None          # a trace is not a dispatch: no injection
         if not (_LEDGER_ON and _trace._ENABLED):
-            return fn(*args, **kwargs)
+            if hook is None:
+                return fn(*args, **kwargs)
+            hook.before_dispatch(name)       # may raise or sleep
+            return hook.after_dispatch(name, fn(*args, **kwargs))
         if not _trace_clean():
             return fn(*args, **kwargs)
+        if hook is not None:
+            hook.before_dispatch(name)       # may raise or sleep
         pre = cache_size() if cache_size is not None else -1
         pre_census = _memledger.census_len()
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
+        if hook is not None:
+            out = hook.after_dispatch(name, out)
         if sync:
             _trace.sync(out)
         wall = time.perf_counter() - t0

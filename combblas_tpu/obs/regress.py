@@ -47,6 +47,7 @@ ARTIFACT_GLOBS = (
     ("SERVE_BENCH*.json", "serve"),
     ("BITS_BENCH*.json", "bits"),
     ("ESC_MICROBENCH*.json", "esc"),
+    ("CHAOS_r*.json", "chaos"),
 )
 
 #: canonical run-row fields (None allowed unless listed in _REQUIRED)

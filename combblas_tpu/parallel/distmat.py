@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from combblas_tpu import obs
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops.semiring import Monoid, Semiring
 from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
@@ -510,11 +511,17 @@ def from_dense(add: Monoid, grid: ProcGrid, dense, zero,
 
 def to_global_coo(a: DistSpMat) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host-side (rows, cols, vals) in global coordinates (the
-    gather-side of SparseCommon; feeds I/O writers and grid rebuilds)."""
-    rows = np.asarray(a.rows)
-    cols = np.asarray(a.cols)
-    vals = np.asarray(a.vals)
-    nnz = np.asarray(a.nnz)
+    gather-side of SparseCommon; feeds I/O writers and grid rebuilds).
+    A deliberate full-matrix blocking readback — bracketed so it lands
+    named on the ledger instead of as a stray sync (the checkpoint
+    writer calls this from the MCL loop)."""
+    with obs.ledger.readback("distmat.to_global_coo",
+                             out_bytes=int(a.rows.nbytes + a.cols.nbytes
+                                           + a.vals.nbytes)):
+        rows = np.asarray(a.rows)
+        cols = np.asarray(a.cols)
+        vals = np.asarray(a.vals)
+        nnz = np.asarray(a.nnz)
     rr, cc, vv = [], [], []
     for i in range(a.grid.pr):
         for j in range(a.grid.pc):

@@ -89,6 +89,15 @@ _M_DENSITY = obm.histogram("spgemm.window_density",
 _M_HUBSPLIT = obm.counter("spgemm.hub_splits",
                           "column windows bisected because their flop "
                           "share exceeded the hub factor x median")
+_M_OOM_DEGRADE = obm.counter(
+    "spgemm.oom_degrade",
+    "phased-window runs re-planned at a reduced flop budget after a "
+    "RESOURCE_EXHAUSTED failure (graceful degradation instead of a "
+    "crash; the rung is picked from memledger.headroom())")
+_M_STUCK_FALLBACK = obm.counter(
+    "spgemm.stuck_fallback",
+    "deferred nnz counts that never came home — the window was placed "
+    "at its CapLadder rung unshrunk (the PR-7 fallback branch)")
 _M_BCAST = obm.counter("spgemm.bcast",
                        "SUMMA tile broadcasts per exchange variant "
                        "(kind=dense|sparse)")
@@ -1085,11 +1094,67 @@ def _annotate_window_costs(windows, variants, at, win_width) -> None:
             obs.costmodel.annotate(rb, lbytes=4.0)
 
 
+_OOM_BUDGET_FLOOR = 1 << 20   # below this, degradation gives up and
+#                               the original RESOURCE_EXHAUSTED surfaces
+
+
+def _degraded_budget(budget: int) -> int:
+    """Pick the reduced phase flop budget after an OOM: at least halve,
+    and when the memory ledger knows the device's headroom, scale so
+    the projected working set (~3 budget-sized buffers: two in-flight
+    windows + accumulator, see the plan-time warn) fits inside it."""
+    new_b = budget // 2
+    try:
+        hr = obs.memledger.headroom()
+        frac = hr.get("headroom_frac")
+        hbm = float(hr.get("hbm_bytes") or 0.0)
+    except Exception:
+        frac, hbm = None, 0.0
+    if frac is not None and hbm > 0:
+        avail = max(0.0, float(frac)) * hbm
+        required = 3.0 * budget * _SLOT_B
+        if required > 0:
+            new_b = min(new_b, int(budget * (avail / required)))
+    return max(new_b, 0)
+
+
 def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                 phases: Optional[int], phase_flop_budget: int,
                 prune_hook, out_cap: Optional[int],
                 cap_round: int,
                 cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
+    """OOM graceful-degradation shell around the phased window loop:
+    a RESOURCE_EXHAUSTED failure (real allocator, or injected by
+    `resilience.faults`) re-plans the multiply at a reduced
+    `phase_flop_budget` — smaller windows, smaller in-flight caps —
+    instead of crashing the solver. The rung is picked from
+    `memledger.headroom()` when the ledger knows the device (never
+    gentler than a halving), floored at 2^20 flops; at the floor the
+    original error surfaces. Re-running is safe: the window loop only
+    donates its own freshly-built accumulators, never `a`/`b`."""
+    from combblas_tpu.resilience import faults as _faults
+    budget = phase_flop_budget
+    want_phases = phases
+    while True:
+        try:
+            return _phased_1x1_run(sr, a, b, phases=want_phases,
+                                   phase_flop_budget=budget,
+                                   prune_hook=prune_hook,
+                                   out_cap=out_cap, cap_round=cap_round,
+                                   cap_ladder=cap_ladder)
+        except Exception as e:      # noqa: BLE001 - classified below
+            if not _faults.is_oom_error(e) or budget <= _OOM_BUDGET_FLOOR:
+                raise
+            budget = max(_degraded_budget(budget), _OOM_BUDGET_FLOOR)
+            want_phases = None       # let the reduced budget drive the plan
+            _M_OOM_DEGRADE.inc()
+
+
+def _phased_1x1_run(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
+                    phases: Optional[int], phase_flop_budget: int,
+                    prune_hook, out_cap: Optional[int],
+                    cap_round: int,
+                    cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
     """Single-tile phased SpGEMM: plan once on host (ONE fetch of each
     operand's structure), then run every phase through one compiled
     dynamic-window kernel (`tile.spgemm_colwindow`). No per-phase host
@@ -1316,7 +1381,10 @@ def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
         already landed), else return None and let the caller fall back
         to the window's CapLadder rung."""
         wi, cp, nnz_ref, handle = item
-        if not _count_is_ready(nnz_ref):
+        # a handle minted under an armed "stuck" fault never reports
+        # ready (resilience.faults): same fallback as a late copy
+        if handle.stuck or not _count_is_ready(nnz_ref):
+            _M_STUCK_FALLBACK.inc(stuck=int(bool(handle.stuck)))
             return None
         with handle.resolve():
             pn = int(np.asarray(nnz_ref))

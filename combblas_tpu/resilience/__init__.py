@@ -1,0 +1,61 @@
+"""Resilience layer: deterministic fault injection + layered recovery.
+
+The serving north star is a system that keeps answering under partial
+failure. This package supplies both halves of that story:
+
+* `faults` — a seedable, deterministic fault-injection layer that
+  intercepts at the existing `obs.ledger` choke points (`instrument`
+  wrappers, `readback`, `readback_deferred`) and injects, per a
+  committed JSON schedule: transient RuntimeErrors, RESOURCE_EXHAUSTED-
+  shaped OOMs, added latency, never-resolving deferred readbacks, and
+  NaN poisoning. Zero cost while disarmed (a single module-global
+  `is None` check on the hot path).
+* `retry` — donation-aware retry-with-backoff: the caller supplies a
+  *factory* that re-materializes arguments per attempt (donated
+  buffers are consumed by a dispatch, successful or not), transient
+  vs permanent classification, and deadline-aware backoff.
+* `breaker` — a per-kind closed/open/half-open circuit breaker used by
+  `GraphService` on top of the predictive shed.
+* `checkpoint` — iterative-solver snapshot/resume (MCL, FastSV) over
+  the `io/mmio` binary surface; bit-exact mid-iteration resume.
+
+Error taxonomy (importable from the package root):
+
+* `InjectedFault`      — base class for every injected failure
+* `TransientFault`     — retry-worthy injected RuntimeError
+* `InjectedOom`        — RESOURCE_EXHAUSTED-shaped allocation failure
+* `is_oom_error(exc)`  — matches injected AND real XLA OOMs
+* `is_transient(exc)`  — the retry layer's default classifier
+"""
+
+from combblas_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector,
+    InjectedFault,
+    InjectedOom,
+    TransientFault,
+    arm,
+    disarm,
+    injected,
+    is_oom_error,
+    is_transient,
+    load_schedule,
+)
+from combblas_tpu.resilience.retry import (  # noqa: F401
+    RetryBudgetExceeded,
+    RetryPolicy,
+    retry_call,
+)
+from combblas_tpu.resilience.breaker import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpenError,
+)
+from combblas_tpu.resilience import checkpoint  # noqa: F401
+
+__all__ = [
+    "FaultInjector", "InjectedFault", "InjectedOom", "TransientFault",
+    "arm", "disarm", "injected", "is_oom_error", "is_transient",
+    "load_schedule",
+    "RetryBudgetExceeded", "RetryPolicy", "retry_call",
+    "CircuitBreaker", "CircuitOpenError",
+    "checkpoint",
+]

@@ -1,0 +1,126 @@
+"""Per-kind circuit breaker (closed / open / half-open).
+
+Layered UNDER the predictive shed in `GraphService`: the shed predicts
+"this batch would miss its deadline"; the breaker observes "this kind
+is actually failing" and stops burning device time (and retry budget)
+on a kind that is down — requests fail fast with `CircuitOpenError`
+until a recovery probe succeeds.
+
+State machine (consecutive-failure flavor — deterministic, no sliding
+windows, which keeps chaos soaks replayable):
+
+* CLOSED    — traffic flows; `failure_threshold` CONSECUTIVE failures
+              trip it to OPEN (any success resets the streak).
+* OPEN      — `allow()` is False until `recovery_s` has elapsed since
+              the trip, then the breaker moves to HALF_OPEN.
+* HALF_OPEN — up to `half_open_max` probe calls are admitted; a
+              success closes the breaker, a failure re-opens it (fresh
+              recovery clock).
+
+The clock is injectable for tests (`clock=time.monotonic` default).
+Thread-safe: serve workers and metric scrapers share instances.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from combblas_tpu import obs
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+_transitions = obs.counter(
+    "resilience_breaker_transitions",
+    "circuit-breaker state transitions, by kind and new state")
+_rejections = obs.counter(
+    "resilience_breaker_rejections",
+    "calls rejected by an open circuit breaker, by kind")
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised (by callers of `allow()`) for traffic rejected while the
+    breaker is open: the kind is failing, fail fast instead of
+    queueing onto a broken path."""
+
+
+class CircuitBreaker:
+    def __init__(self, kind: str = "", *, failure_threshold: int = 5,
+                 recovery_s: float = 1.0, half_open_max: int = 1,
+                 clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.kind = kind
+        self.failure_threshold = int(failure_threshold)
+        self.recovery_s = float(recovery_s)
+        self.half_open_max = max(int(half_open_max), 1)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._failures = 0            # consecutive, while closed
+        self._opened_at = 0.0
+        self._probes = 0              # admitted while half-open
+        self._trips = 0
+
+    def _to(self, state: str) -> None:
+        # lock held by caller
+        if state == self._state:
+            return
+        self._state = state
+        _transitions.inc(kind=self.kind, state=state)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (self._state == OPEN
+                and self._clock() - self._opened_at >= self.recovery_s):
+            self._to(HALF_OPEN)
+            self._probes = 0
+
+    def allow(self) -> bool:
+        """True when a call may proceed. While half-open, admits at
+        most `half_open_max` in-flight probes; further traffic is
+        rejected until a probe reports back."""
+        with self._lock:
+            self._maybe_half_open()
+            if self._state == CLOSED:
+                return True
+            if self._state == HALF_OPEN and self._probes < self.half_open_max:
+                self._probes += 1
+                return True
+            _rejections.inc(kind=self.kind)
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state == HALF_OPEN:
+                self._to(CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            now = self._clock()
+            if self._state == HALF_OPEN:
+                self._opened_at = now
+                self._to(OPEN)
+                return
+            self._failures += 1
+            if self._state == CLOSED and \
+                    self._failures >= self.failure_threshold:
+                self._failures = 0
+                self._opened_at = now
+                self._trips += 1
+                self._to(OPEN)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            self._maybe_half_open()
+            return {"kind": self.kind, "state": self._state,
+                    "consecutive_failures": self._failures,
+                    "trips": self._trips,
+                    "open_for_s": (round(self._clock() - self._opened_at, 3)
+                                   if self._state == OPEN else 0.0)}

@@ -1,0 +1,116 @@
+"""Iterative-solver checkpoint/resume over the `io/mmio` binary surface.
+
+CombBLAS 2.0 treats checkpoint-by-persistence as THE resilience
+mechanism at scale (SURVEY §5): a long solver run periodically
+persists its loop-carry state, and a faulted run resumes mid-iteration
+instead of restarting from zero. This module is that mechanism for the
+two iterative solvers:
+
+* MCL   — carry is the iterated matrix `a` plus the pinned capacity
+          and the iteration counter (`models/mcl._mcl_loop_fused`
+          checkpoints at the loop head, after the chaos decision —
+          exactly the state the loop itself would hold entering
+          iteration `it`, so resume is bit-exact by construction).
+* FastSV — carry is the `(f, gf)` label vectors plus the completed
+          iteration count (`models/cc.fastsv` runs a chunked driver
+          when checkpointing is requested).
+
+Layout: a checkpoint is a PREFIX, not a single file —
+`<prefix>.meta.json` (written LAST, atomically via `os.replace`) plus
+mmio binary payloads (`<prefix>.a.npz`, `<prefix>.f.npz`, ...). A
+crash mid-save leaves stale payloads but no new meta, so `latest()`
+readers never observe a torn checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from combblas_tpu import obs
+from combblas_tpu.io import mmio
+from combblas_tpu.parallel import distvec as dv
+from combblas_tpu.parallel.grid import ROW_AXIS
+
+FORMAT = 1
+
+_saves = obs.counter("resilience_checkpoint_saves",
+                     "solver checkpoints written, by solver")
+_resumes = obs.counter("resilience_checkpoint_resumes",
+                       "solver runs resumed from a checkpoint, by solver")
+
+
+def _write_meta(prefix, meta: dict) -> None:
+    tmp = f"{prefix}.meta.json.tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, f"{prefix}.meta.json")
+
+
+def read_meta(prefix) -> dict | None:
+    """The checkpoint's metadata, or None when no complete checkpoint
+    exists at `prefix` (meta is written last — its presence is the
+    commit point)."""
+    try:
+        with open(f"{prefix}.meta.json") as f:
+            meta = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+    if meta.get("format") != FORMAT:
+        return None
+    return meta
+
+
+# -- MCL ------------------------------------------------------------------
+
+def save_mcl(prefix, a, *, it: int, cap_pin, rungs=None) -> None:
+    """Snapshot the MCL loop carry entering iteration `it`: the
+    iterated matrix (global-COO binary), the pinned capacity the loop
+    would re-fit against, and the CapLadder rungs minted so far (so a
+    resumed run re-plans with the same capacities)."""
+    mmio.save_matrix(f"{prefix}.a.npz", a)
+    _write_meta(prefix, {
+        "format": FORMAT, "solver": "mcl", "it": int(it),
+        "cap_pin": int(cap_pin) if cap_pin is not None else None,
+        "rungs": sorted(int(r) for r in rungs) if rungs else [],
+        "nnz_cap": int(a.cap)})
+    _saves.inc(solver="mcl")
+
+
+def load_mcl(add, grid, prefix):
+    """Returns `(a, meta)` — the matrix restored with its checkpointed
+    capacity (shape-stable resume) and the metadata dict. Raises
+    FileNotFoundError when no complete checkpoint exists."""
+    meta = read_meta(prefix)
+    if meta is None or meta.get("solver") != "mcl":
+        raise FileNotFoundError(f"no MCL checkpoint at {prefix!r}")
+    a = mmio.load_matrix(add, grid, f"{prefix}.a.npz",
+                         cap=meta.get("nnz_cap"))
+    _resumes.inc(solver="mcl")
+    return a, meta
+
+
+# -- FastSV ---------------------------------------------------------------
+
+def save_fastsv(prefix, grid, f, gf, *, it: int, glen: int) -> None:
+    """Snapshot the FastSV carry after `it` completed iterations. The
+    label vectors are global arrays inside the jitted loop; they ride
+    the mmio vector surface as row-axis DistVecs."""
+    mmio.save_vector(f"{prefix}.f.npz", dv.from_global(grid, ROW_AXIS, f))
+    mmio.save_vector(f"{prefix}.gf.npz", dv.from_global(grid, ROW_AXIS, gf))
+    _write_meta(prefix, {"format": FORMAT, "solver": "fastsv",
+                         "it": int(it), "glen": int(glen)})
+    _saves.inc(solver="fastsv")
+
+
+def load_fastsv(grid, prefix):
+    """Returns `(f, gf, meta)` with `f`/`gf` as global jnp arrays."""
+    import jax.numpy as jnp
+    meta = read_meta(prefix)
+    if meta is None or meta.get("solver") != "fastsv":
+        raise FileNotFoundError(f"no FastSV checkpoint at {prefix!r}")
+    f = jnp.asarray(mmio.load_vector(grid, f"{prefix}.f.npz").to_global())
+    gf = jnp.asarray(mmio.load_vector(grid, f"{prefix}.gf.npz").to_global())
+    _resumes.inc(solver="fastsv")
+    return f, gf, meta

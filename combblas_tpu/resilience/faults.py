@@ -1,0 +1,286 @@
+"""Deterministic fault injection at the `obs.ledger` choke points.
+
+Every hot dispatch already flows through an `obs.ledger.instrument`
+wrapper, and every blocking/async device->host fetch through
+`obs.ledger.readback` / `readback_deferred`. This module arms a single
+module-global hook inside `obs.ledger` (checked with one `is None`
+load — free while disarmed) and injects failures per a committed JSON
+fault schedule:
+
+```json
+{
+  "seed": 1,
+  "rules": [
+    {"match": "serve.*",            "kind": "transient", "p": 0.10, "max": 8},
+    {"match": "mcl.megastep",       "kind": "oom",       "at": [3]},
+    {"match": "serve.bfs*",         "kind": "latency",   "every": 4,
+     "latency_s": 0.005},
+    {"match": "spgemm.nnz_deferred","kind": "stuck",     "at": [1, 2]},
+    {"match": "serve.spmv*",        "kind": "nan",       "at": [0]}
+  ]
+}
+```
+
+Rule fields:
+
+* `match`      — fnmatch pattern over the ledger site name (required).
+* `kind`       — one of `transient` (raises `TransientFault`), `oom`
+                 (raises `InjectedOom` with a RESOURCE_EXHAUSTED-shaped
+                 message), `latency` (sleeps `latency_s`), `stuck`
+                 (the deferred-readback handle never reports ready, so
+                 pipelines must take their fallback path), `nan`
+                 (poisons float outputs with NaN).
+* exactly one trigger: `at` (explicit 0-based per-site call indices),
+  `every` (every k-th call), or `p` (pseudo-random per call, derived
+  deterministically from `(seed, rule_index, site, call_index)` — NO
+  global RNG state, so concurrency and call interleaving across
+  different sites cannot change decisions).
+* `after`      — skip the first N calls (default 0).
+* `max`        — cap on total fires for the rule (default unbounded).
+* `latency_s`  — sleep duration for `kind == "latency"`.
+
+Determinism contract: a site's decisions depend only on the schedule
+and on that site's own call ordinal for the rule — both stable across
+runs for deterministic drivers. Counters are per `(rule, site)` and
+updated under one lock (the fault path is not a hot path; the
+*disarmed* path is the one that must stay free).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fnmatch
+import hashlib
+import json
+import threading
+import time
+
+from combblas_tpu import obs
+from combblas_tpu.obs import ledger as _ledger
+
+FAULT_KINDS = ("transient", "oom", "latency", "stuck", "nan")
+
+#: kinds evaluated before a dispatch/readback executes
+_PRE_KINDS = ("transient", "oom", "latency")
+
+_faults_injected = obs.counter(
+    "resilience_faults_injected",
+    "faults injected by the chaos layer, by kind")
+
+
+class InjectedFault(RuntimeError):
+    """Base class for every failure raised by the fault injector."""
+
+
+class TransientFault(InjectedFault):
+    """A retry-worthy injected failure (models a flaky dispatch)."""
+
+
+class InjectedOom(InjectedFault):
+    """An allocation failure shaped like XLA's RESOURCE_EXHAUSTED."""
+
+    def __init__(self, site: str, nbytes: int = 1 << 30):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to "
+            f"allocate {nbytes} bytes. [injected at {site}]")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True for injected OOMs and for real XLA RESOURCE_EXHAUSTED
+    failures (matched on the status string — jaxlib raises them as
+    `XlaRuntimeError`, whose class identity is version-dependent)."""
+    if isinstance(exc, InjectedOom):
+        return True
+    return "RESOURCE_EXHAUSTED" in str(exc)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Default transient-vs-permanent classifier for the retry layer.
+    Transient: injected transients, OOMs (a retry at lower capacity or
+    after a competing batch drains can succeed), and runtime statuses
+    that name a retryable condition. Everything else (shape errors,
+    TypeError, ...) is permanent — retrying cannot help."""
+    if isinstance(exc, TransientFault):
+        return True
+    if is_oom_error(exc):
+        return True
+    msg = str(exc)
+    return any(tag in msg for tag in ("UNAVAILABLE", "ABORTED",
+                                      "DEADLINE_EXCEEDED"))
+
+
+class _Rule:
+    __slots__ = ("index", "match", "kind", "at", "every", "p", "after",
+                 "max", "latency_s", "fired")
+
+    def __init__(self, index: int, spec: dict):
+        self.index = index
+        self.match = spec["match"]
+        self.kind = spec["kind"]
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"rule {index}: unknown fault kind "
+                             f"{self.kind!r} (want one of {FAULT_KINDS})")
+        self.at = frozenset(spec["at"]) if "at" in spec else None
+        self.every = int(spec["every"]) if "every" in spec else None
+        self.p = float(spec["p"]) if "p" in spec else None
+        triggers = sum(x is not None for x in (self.at, self.every, self.p))
+        if triggers != 1:
+            raise ValueError(f"rule {index} ({self.match!r}): need exactly "
+                             f"one of at/every/p, got {triggers}")
+        self.after = int(spec.get("after", 0))
+        self.max = spec.get("max")
+        self.latency_s = float(spec.get("latency_s", 0.001))
+        self.fired = 0
+
+
+def _hash_frac(seed: int, rule_index: int, site: str, k: int) -> float:
+    """Deterministic uniform-[0,1) draw for (seed, rule, site, call#)."""
+    h = hashlib.sha256(
+        f"{seed}:{rule_index}:{site}:{k}".encode()).digest()
+    return int.from_bytes(h[:8], "big") / float(1 << 64)
+
+
+class FaultInjector:
+    """Evaluates a fault schedule against ledger site names. Install
+    with `arm()` / the `injected()` context manager."""
+
+    def __init__(self, schedule: dict):
+        self.seed = int(schedule.get("seed", 0))
+        self.rules = [_Rule(i, spec)
+                      for i, spec in enumerate(schedule.get("rules", []))]
+        self._counts: dict = {}       # (rule_index, site) -> calls seen
+        self._lock = threading.Lock()
+        self.injected: dict = {k: 0 for k in FAULT_KINDS}
+
+    @classmethod
+    def from_json(cls, path) -> "FaultInjector":
+        with open(path) as f:
+            return cls(json.load(f))
+
+    # -- decision core ---------------------------------------------------
+
+    def _fire(self, site: str, kinds) -> "_Rule | None":
+        """First matching rule of one of `kinds` that fires for this
+        call. Each matching rule's per-site call counter advances once
+        per check, fired or not — that is what makes `at`/`every`
+        indices meaningful per site."""
+        hit = None
+        with self._lock:
+            for r in self.rules:
+                if r.kind not in kinds or not fnmatch.fnmatch(site, r.match):
+                    continue
+                key = (r.index, site)
+                k = self._counts.get(key, 0)
+                self._counts[key] = k + 1
+                if hit is not None or k < r.after:
+                    continue
+                if r.max is not None and r.fired >= r.max:
+                    continue
+                if r.at is not None:
+                    fire = k in r.at
+                elif r.every is not None:
+                    fire = (k + 1) % r.every == 0
+                else:
+                    fire = _hash_frac(self.seed, r.index, site, k) < r.p
+                if fire:
+                    r.fired += 1
+                    self.injected[r.kind] += 1
+                    hit = r
+        if hit is not None:
+            _faults_injected.inc(kind=hit.kind)
+        return hit
+
+    # -- ledger hook surface (called from obs.ledger) --------------------
+
+    def before_dispatch(self, site: str) -> None:
+        """Pre-call injection: latency, transient, OOM. May raise."""
+        r = self._fire(site, _PRE_KINDS)
+        if r is None:
+            return
+        if r.kind == "latency":
+            time.sleep(r.latency_s)
+        elif r.kind == "transient":
+            raise TransientFault(f"injected transient fault at {site} "
+                                 f"(rule {r.index})")
+        else:
+            raise InjectedOom(site)
+
+    def after_dispatch(self, site: str, out):
+        """Post-call injection: NaN-poison float array leaves."""
+        r = self._fire(site, ("nan",))
+        if r is None:
+            return out
+        return _poison(out)
+
+    def stuck_readback(self, site: str) -> bool:
+        """True when a deferred readback minted at `site` must never
+        report ready (the pipeline has to take its fallback path)."""
+        return self._fire(site, ("stuck",)) is not None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"seed": self.seed,
+                    "injected": dict(self.injected),
+                    "rules": [{"match": r.match, "kind": r.kind,
+                               "fired": r.fired} for r in self.rules]}
+
+
+def _poison(out):
+    """Replace every inexact array leaf with NaNs of the same
+    shape/dtype. Non-float leaves (indices, counts) pass through —
+    poisoning those would be a shape/validity fault, not a data one."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        dt = getattr(x, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.inexact):
+            return jnp.full_like(x, jnp.nan)
+        return x
+
+    return jax.tree_util.tree_map(leaf, out)
+
+
+# -- arming ---------------------------------------------------------------
+
+_ACTIVE: FaultInjector | None = None
+
+
+def arm(injector: FaultInjector) -> FaultInjector:
+    """Install `injector` as the process-wide fault hook."""
+    global _ACTIVE
+    _ACTIVE = injector
+    _ledger.set_fault_hook(injector)
+    return injector
+
+
+def disarm() -> None:
+    """Remove the fault hook (the ledger hot path is free again)."""
+    global _ACTIVE
+    _ACTIVE = None
+    _ledger.set_fault_hook(None)
+
+
+def active() -> FaultInjector | None:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def injected(schedule):
+    """Arm a schedule (dict, FaultInjector, or JSON path) for the
+    duration of the block; always disarms on exit."""
+    if isinstance(schedule, FaultInjector):
+        inj = schedule
+    elif isinstance(schedule, dict):
+        inj = FaultInjector(schedule)
+    else:
+        inj = FaultInjector.from_json(schedule)
+    arm(inj)
+    try:
+        yield inj
+    finally:
+        disarm()
+
+
+def load_schedule(path) -> FaultInjector:
+    return FaultInjector.from_json(path)

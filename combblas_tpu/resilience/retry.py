@@ -1,0 +1,114 @@
+"""Donation-aware retry with deterministic backoff.
+
+Why a *factory* and not the classic `retry(fn, *args)`: the hot
+dispatches this wraps donate input buffers (`donate_argnums`), and a
+donated buffer is CONSUMED by the attempt — successful or not, the
+arrays passed to a failed dispatch may already be deleted. A retry
+that re-submits the same objects would crash with a deleted-buffer
+error (or worse, alias freed memory on hardware). The contract here:
+`make_call(attempt)` returns a zero-arg thunk whose arguments were
+re-materialized for THIS attempt (rebuilt from host state, re-sliced
+from an undonated source, or built by a donation-disabled variant of
+the executable). Callers whose dispatches do not donate can close
+over their args freely.
+
+Classification: `classify(exc) -> bool` (default
+`faults.is_transient`) decides retry-worthiness. Permanent failures
+re-raise immediately; transient ones retry up to
+`policy.max_attempts` with exponential backoff, clipped to a request
+deadline when one is given — a retry that cannot finish before the
+deadline is not attempted.
+
+Accounting: every retry lands in the dispatch ledger as a
+`kind="retry"` record under `<name>.retry` (visible in `top_k` /
+`format_table`, ignored by the dispatch/readback totals) and on the
+`resilience_retries` counter, labeled by site and outcome.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from combblas_tpu import obs
+from combblas_tpu.obs import ledger as _ledger
+from combblas_tpu.resilience import faults as _faults
+
+_retries = obs.counter(
+    "resilience_retries",
+    "retry attempts by the resilience layer, by site and outcome")
+
+
+class RetryBudgetExceeded(RuntimeError):
+    """All attempts were spent (or the deadline left no room for
+    another). Carries the last underlying failure as `__cause__`."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    max_attempts: int = 3          # total attempts, first call included
+    backoff_s: float = 0.02        # sleep before attempt 2
+    backoff_mult: float = 2.0      # exponential growth per attempt
+    max_backoff_s: float = 0.5
+
+    def backoff_for(self, attempt: int) -> float:
+        """Sleep before `attempt` (1-based; attempt 1 never sleeps).
+        Deterministic — no jitter, so chaos runs replay exactly."""
+        if attempt <= 1:
+            return 0.0
+        return min(self.backoff_s * self.backoff_mult ** (attempt - 2),
+                   self.max_backoff_s)
+
+
+def retry_call(make_call, *, policy: RetryPolicy | None = None,
+               classify=None, deadline: float | None = None,
+               name: str = "call", on_retry=None):
+    """Run `make_call(attempt)()` with transient-failure retries.
+
+    * `make_call(attempt)` — factory invoked once per attempt (1-based);
+      must re-materialize any donated arguments (see module docstring).
+    * `classify(exc)` — True = transient (retryable); default
+      `faults.is_transient`.
+    * `deadline` — absolute `time.monotonic()` stamp; backoff sleeps
+      and further attempts are abandoned once it cannot be met.
+    * `on_retry(attempt, exc)` — observer hook (breaker integration).
+
+    Returns the successful attempt's result. Permanent failures
+    re-raise with their original type; exhausted/deadline-blocked
+    retries raise `RetryBudgetExceeded` with the last failure as
+    `__cause__` (so upstream classifiers treat the give-up as
+    permanent instead of retrying the retrier).
+    """
+    policy = policy or RetryPolicy()
+    classify = classify or _faults.is_transient
+    attempts = max(int(policy.max_attempts), 1)
+    last = None
+    for attempt in range(1, attempts + 1):
+        if attempt > 1:
+            pause = policy.backoff_for(attempt)
+            if deadline is not None:
+                room = deadline - time.monotonic()
+                if room <= pause:       # cannot even finish the sleep
+                    break
+            t0 = time.perf_counter()
+            if pause:
+                time.sleep(pause)
+            _ledger.record(f"{name}.retry", "retry", t0,
+                           time.perf_counter() - t0)
+            _retries.inc(site=name, outcome="attempt")
+            if on_retry is not None:
+                on_retry(attempt, last)
+        try:
+            out = make_call(attempt)()
+            if attempt > 1:
+                _retries.inc(site=name, outcome="recovered")
+            return out
+        except Exception as e:                # noqa: BLE001 - classified
+            last = e
+            if not classify(e):
+                _retries.inc(site=name, outcome="permanent")
+                raise
+    _retries.inc(site=name, outcome="exhausted")
+    raise RetryBudgetExceeded(
+        f"{name}: no attempt left (spent {attempts}, "
+        f"deadline={'set' if deadline is not None else 'none'})") from last

@@ -35,9 +35,10 @@ Not imported from the package root (it pulls `models.bfs`): use
 ``from combblas_tpu import serve`` explicitly.
 """
 
+from combblas_tpu.resilience.breaker import CircuitOpenError
 from combblas_tpu.serve.queue import (
     DeadlineExceededError, QueueFullError, Request, RequestQueue,
-    ResultHandle, ServeError, ServiceStoppedError,
+    ResultHandle, ServeError, ServiceStoppedError, WorkerCrashedError,
 )
 from combblas_tpu.serve.batcher import Batch, DynamicBatcher, bucket_for
 from combblas_tpu.serve.plans import PlanCache, PlanKey

@@ -56,9 +56,11 @@ from combblas_tpu.parallel import densemat as dmm
 from combblas_tpu.parallel.grid import COL_AXIS, ROW_AXIS
 from combblas_tpu.serve.batcher import Batch, DynamicBatcher, bucket_for
 from combblas_tpu.serve.plans import PlanCache, PlanKey, _plan_name
+from combblas_tpu.resilience.breaker import CircuitBreaker, CircuitOpenError
+from combblas_tpu.resilience.retry import RetryPolicy, retry_call
 from combblas_tpu.serve.queue import (
     DeadlineExceededError, QueueFullError, Request, RequestQueue,
-    ResultHandle, ServiceStoppedError,
+    ResultHandle, ServiceStoppedError, WorkerCrashedError,
 )
 from combblas_tpu.utils.config import ServeConfig
 
@@ -92,6 +94,10 @@ _mem_headroom = obs.gauge(
 _plan_bytes = obs.gauge(
     "serve.plan_cache_bytes",
     "compile-time HBM bytes of cached plan executables, by kind")
+_worker_crashes = obs.counter(
+    "serve.worker_crashes",
+    "worker-thread crashes caught by the supervisor (each one drains "
+    "queued futures with WorkerCrashedError and restarts the loop)")
 
 
 @dataclasses.dataclass
@@ -136,7 +142,8 @@ class GraphService:
         # when tracing is enabled; `stats` always counts
         self.stats = {"queries": 0, "results": 0, "batches": 0,
                       "dispatches": 0, "warmup_dispatches": 0,
-                      "shed": 0, "partials": 0, "rejected": 0}
+                      "shed": 0, "partials": 0, "rejected": 0,
+                      "worker_restarts": 0, "retries": 0}
         self._stats_lock = threading.Lock()
         # per-kind SLO ledger: kind -> {"good": n, "bad": n}. A request
         # is good when it completes within cfg.slo_latency_s of
@@ -160,6 +167,14 @@ class GraphService:
             _latency.use_sketch(True)
         self._cc_labels = None          # lazy device label vector
         self._cc_lock = threading.Lock()
+        # resilience: supervision state + per-kind circuit breakers
+        # (created lazily; breaker_threshold=0 disables the breaker)
+        self._worker_dead = False
+        self._breakers: dict = {}
+        self._breaker_lock = threading.Lock()
+        self._retry_policy = RetryPolicy(
+            max_attempts=self.cfg.retry_max_attempts,
+            backoff_s=self.cfg.retry_backoff_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._metrics_server = None
@@ -215,9 +230,24 @@ class GraphService:
         started = self._thread is not None
         with self._stats_lock:
             stats = dict(self.stats)
+        with self._breaker_lock:
+            breakers = {k: b.snapshot() for k, b in
+                        sorted(self._breakers.items())}
         return {
-            "healthy": (not started) or self._thread.is_alive(),
+            "healthy": ((not started) or self._thread.is_alive())
+            and not self._worker_dead,
             "started": started,
+            # degraded-with-restart-count: the worker crashed at least
+            # once (queued futures were failed fast) but the service is
+            # still taking traffic — dashboards distinguish "limping"
+            # from the healthy=false "dead" verdict
+            "resilience": {
+                "worker_restarts": stats["worker_restarts"],
+                "worker_dead": self._worker_dead,
+                "degraded": stats["worker_restarts"] > 0,
+                "retries": stats["retries"],
+                "breakers": breakers,
+            },
             "stats": stats,
             "queue_depth": len(self.queue),
             "queue_high_water": self.queue.high_water,
@@ -331,6 +361,11 @@ class GraphService:
         # stopping/stopped service refuses
         if self._stop.is_set():
             raise ServiceStoppedError("service is stopped")
+        if self._worker_dead:
+            raise WorkerCrashedError(
+                "serve worker is dead (crashed more than "
+                f"{self.cfg.worker_max_restarts} times); refusing new "
+                "work — restart the service")
         if deadline_s is None:
             deadline_s = self.cfg.default_deadline_s
         now = time.monotonic()
@@ -407,6 +442,33 @@ class GraphService:
     # ------------------------------------------------------------------
 
     def _worker(self) -> None:
+        """Supervisor: runs `_worker_loop` and, when it CRASHES (an
+        exception escaping the per-batch fan-out — e.g. batch
+        formation itself raising), fails every queued future fast with
+        `WorkerCrashedError` instead of stranding clients on handles
+        nobody will ever resolve, then restarts the loop up to
+        `cfg.worker_max_restarts` times. Beyond that the service is
+        dead: submissions refuse, /healthz goes false."""
+        while True:
+            try:
+                self._worker_loop()
+                return                       # clean stop/drain exit
+            except BaseException as e:       # noqa: BLE001 — supervised
+                _worker_crashes.inc()
+                with self._stats_lock:
+                    self.stats["worker_restarts"] += 1
+                    restarts = self.stats["worker_restarts"]
+                for r in self.queue.drain():
+                    if not r.handle.done():
+                        r.handle.set_exception(WorkerCrashedError(
+                            f"serve worker crashed ({e!r}); request "
+                            "failed fast"))
+                        self._note_shed(r, "worker_crash")
+                if restarts > self.cfg.worker_max_restarts:
+                    self._worker_dead = True
+                    return
+
+    def _worker_loop(self) -> None:
         while True:
             if self._stop.is_set() and len(self.queue) == 0:
                 return
@@ -453,11 +515,40 @@ class GraphService:
         return Batch(batch.kind, keep,
                      bucket_for(len(keep), self.cfg.buckets))
 
+    def _breaker(self, kind: str) -> Optional[CircuitBreaker]:
+        """Per-base-kind breaker ("spmv:<sr>" pools under "spmv", like
+        the SLO ledger); None when disabled (breaker_threshold=0)."""
+        if self.cfg.breaker_threshold <= 0:
+            return None
+        base = kind.split(":", 1)[0]
+        with self._breaker_lock:
+            br = self._breakers.get(base)
+            if br is None:
+                br = self._breakers[base] = CircuitBreaker(
+                    base,
+                    failure_threshold=self.cfg.breaker_threshold,
+                    recovery_s=self.cfg.breaker_recovery_s,
+                    half_open_max=self.cfg.breaker_half_open_max)
+            return br
+
     def _execute(self, batch: Batch) -> None:
         if batch.kind != "bfs" and self.cfg.predictive_shed:
             batch = self._shed_predicted(batch)
             if batch is None:
                 return
+        # circuit breaker, AFTER the predictive shed: the shed predicts
+        # a deadline miss, the breaker observes the kind actually
+        # failing — open means fail fast instead of burning device time
+        # (and retry budget) on a broken path
+        br = self._breaker(batch.kind)
+        if br is not None and not br.allow():
+            for r in batch.requests:
+                r.handle.set_exception(CircuitOpenError(
+                    f"{batch.kind} circuit open after repeated dispatch "
+                    "failures; failing fast until a recovery probe "
+                    "succeeds"))
+                self._note_shed(r, "breaker")
+            return
         # propagate the request trace ids onto the worker thread: the
         # batch binds its head request's id thread-locally (ledger
         # records stamp it) and lists EVERY member id on the batch span
@@ -468,20 +559,51 @@ class GraphService:
             with obs.span("serve.batch", kind=batch.kind,
                           width=len(batch.requests), bucket=batch.bucket,
                           trace_ids=ids):
-                if batch.kind == "bfs":
-                    self._run_bfs(batch)
-                elif batch.kind == "cc":
-                    self._run_cc(batch)
-                elif batch.kind.startswith("spmv:"):
-                    self._run_spmv(batch)
-                else:
-                    raise ValueError(
-                        f"unknown query kind {batch.kind!r}")
+                try:
+                    self._dispatch(batch)
+                except BaseException:
+                    if br is not None:
+                        br.record_failure()
+                    raise
+                if br is not None:
+                    br.record_success()
         finally:
             obs.set_trace_id(None)
         with self._stats_lock:
             self.stats["batches"] += 1
         _occupancy.observe(batch.occupancy, kind=batch.kind)
+
+    def _dispatch(self, batch: Batch) -> None:
+        """One batch -> device, with transient-failure retry. Each
+        runner rebuilds its device arrays from the requests' host-side
+        payloads, so every attempt re-materializes its arguments — the
+        donation-aware retry contract (serve dispatches never donate,
+        but the property must hold for any runner that starts to).
+        Deadline-aware: no retry is attempted past the batch's tightest
+        request deadline."""
+        if batch.kind == "bfs":
+            runner = self._run_bfs
+        elif batch.kind == "cc":
+            runner = self._run_cc
+        elif batch.kind.startswith("spmv:"):
+            runner = self._run_spmv
+        else:
+            raise ValueError(f"unknown query kind {batch.kind!r}")
+        if self.cfg.retry_max_attempts <= 1:
+            runner(batch)
+            return
+        deadlines = [r.deadline for r in batch.requests
+                     if r.deadline is not None]
+
+        def on_retry(attempt, exc):
+            with self._stats_lock:
+                self.stats["retries"] += 1
+
+        retry_call(lambda attempt: lambda: runner(batch),
+                   policy=self._retry_policy,
+                   deadline=min(deadlines) if deadlines else None,
+                   name=f"serve.{batch.kind.split(':', 1)[0]}",
+                   on_retry=on_retry)
 
     def _finish(self, req: Request, value) -> None:
         req.handle.set_result(value)

@@ -45,14 +45,26 @@ def _plan_name(key: PlanKey) -> str:
 
 @dataclasses.dataclass
 class PlanEntry:
-    fn: Callable
+    """One cache slot. `ready` is the single-flight latch: the first
+    caller claims the slot (fn=None) and builds outside the lock;
+    concurrent callers of the same key park on `ready` instead of
+    building a duplicate. A builder that raises mid-compile must NOT
+    poison the slot: the entry is removed (next caller rebuilds) and
+    the exception is fanned to every parked waiter via `error`."""
+
+    fn: Callable | None = None
     hits: int = 0
+    ready: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    error: BaseException | None = None
 
 
 class PlanCache:
     """key -> executor map. `get_or_build` is the single choke point:
-    the builder runs at most once per key (double-checked under the
-    lock), every later lookup is a hit."""
+    the builder runs at most once per key (single-flight — racing
+    callers wait for the in-progress build), every later lookup is a
+    hit. A failed build surfaces to the builder AND its waiters, and
+    leaves no entry behind."""
 
     def __init__(self):
         self._plans: dict[PlanKey, PlanEntry] = {}
@@ -70,26 +82,44 @@ class PlanCache:
                      builder: Callable[[], Callable]) -> Callable:
         with self._lock:
             e = self._plans.get(key)
-            if e is not None:
+            if e is None:
+                e = self._plans[key] = PlanEntry()
+                lead = True
+            else:
+                lead = False
+                if e.fn is not None:
+                    e.hits += 1
+                    _plan_hits.inc(kind=key.kind, bucket=key.bucket)
+                    return e.fn
+        if not lead:
+            # single-flight waiter: park OUTSIDE the lock until the
+            # lead's build settles, then share its outcome
+            e.ready.wait()
+            if e.error is not None:
+                raise e.error
+            with self._lock:
                 e.hits += 1
                 _plan_hits.inc(kind=key.kind, bucket=key.bucket)
                 return e.fn
-        # build OUTSIDE the lock (compiles are long; lookups of other
-        # keys must not stall behind them), then settle races under it.
-        # Every built executable goes through the dispatch ledger — one
-        # wrapper per plan, named by its key, so serve dispatches land
-        # in the flight recorder with executable-level attribution
-        # (pass-through when the ledger is disabled).
-        fn = obs.instrument(builder(), _plan_name(key))
+        # lead builder, OUTSIDE the lock (compiles are long; lookups of
+        # other keys must not stall behind them). Every built executable
+        # goes through the dispatch ledger — one wrapper per plan, named
+        # by its key, so serve dispatches land in the flight recorder
+        # with executable-level attribution (pass-through when the
+        # ledger is disabled).
+        try:
+            fn = obs.instrument(builder(), _plan_name(key))
+        except BaseException as exc:
+            with self._lock:
+                e.error = exc
+                self._plans.pop(key, None)   # next caller rebuilds
+            e.ready.set()
+            raise
         with self._lock:
-            e = self._plans.get(key)
-            if e is None:
-                e = self._plans[key] = PlanEntry(fn)
-                _plan_misses.inc(kind=key.kind, bucket=key.bucket)
-            else:
-                e.hits += 1
-                _plan_hits.inc(kind=key.kind, bucket=key.bucket)
-            return e.fn
+            e.fn = fn
+            _plan_misses.inc(kind=key.kind, bucket=key.bucket)
+        e.ready.set()
+        return fn
 
     def stats(self) -> dict:
         with self._lock:

@@ -39,6 +39,12 @@ class ServiceStoppedError(ServeError):
     """Submitted to, or left pending in, a stopped service."""
 
 
+class WorkerCrashedError(ServeError):
+    """The worker thread died executing this request's queue (it is
+    restarted up to `ServeConfig.worker_max_restarts` times; queued
+    futures are failed fast instead of hanging forever)."""
+
+
 class ResultHandle:
     """Future for one request: the worker thread fulfills it, the
     client blocks on `result()`. Carries the request's ``trace_id`` so

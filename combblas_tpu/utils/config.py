@@ -159,6 +159,27 @@ class ServeConfig:
     # (gauges `serve.slo_burn_rate{kind}` on /metrics and /varz)
     slo_latency_s: float = 0.25
     slo_target: float = 0.99
+    # --- resilience layer (combblas_tpu.resilience) -------------------
+    # worker supervision: a crashed worker thread drains every queued
+    # future with WorkerCrashedError (nothing hangs) and restarts up to
+    # this many times; beyond it the service is dead (/healthz false,
+    # submissions refused). 0 = fail permanently on the first crash.
+    worker_max_restarts: int = 2
+    # per-kind circuit breaker layered on the predictive shed:
+    # breaker_threshold CONSECUTIVE dispatch failures open the kind
+    # (requests fail fast with CircuitOpenError, shed reason
+    # "breaker"); after breaker_recovery_s one half-open probe batch is
+    # admitted. 0 disables the breaker entirely.
+    breaker_threshold: int = 5
+    breaker_recovery_s: float = 1.0
+    breaker_half_open_max: int = 1
+    # dispatch retry: transient failures (resilience.faults
+    # classification) re-dispatch with deterministic exponential
+    # backoff, re-materializing the batch's device arrays from the
+    # host-side payloads each attempt (serve dispatches never donate,
+    # so re-dispatch is always safe). 1 = no retry.
+    retry_max_attempts: int = 2
+    retry_backoff_s: float = 0.02
 
 
 def parse_cli(cls: Type[T], argv: Optional[list] = None,

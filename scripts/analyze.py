@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis gate: one command, seven passes, one verdict.
+"""Static-analysis gate: one command, eight passes, one verdict.
 
     PYTHONPATH=/root/repo python scripts/analyze.py --gate
 
@@ -27,6 +27,9 @@ code):
             reads inside traced code, unstable jit cache keys, and
             shard_map collectives vs declared mesh axes
             (trace_hazard.json)
+  chaos     chaos-recovery budget over the committed CHAOS_r*.json
+            soak artifacts: zero unresolved handles, bounded shed,
+            bit-exact recovery, vacuity floors (chaos.json)
 
 Exit status: 0 iff no unsuppressed finding (the CI gate contract —
 `pytest -m quick` runs the same passes via tests/test_analysis.py).
@@ -43,8 +46,8 @@ against the committed copy to flag waiver growth.
                   fixtures in tests/fixtures/analysis/ and verify each
                   rule actually FIRES (exit 0 = the gate bites)
     --json        machine-readable findings on stdout
-    --passes a,b  subset of budgets,retrace,locks,obs,perf,mem,trace
-                  (default: all)
+    --passes a,b  subset of budgets,retrace,locks,obs,perf,mem,trace,
+                  chaos (default: all)
     --entry NAME  restrict the budget pass to one registry entry
     --diff [REV]  fast iteration loop: run only the AST passes (locks,
                   trace) whole-tree and report findings in files
@@ -80,7 +83,7 @@ def _cpu_env():
 
 
 ALL_PASSES = ("budgets", "retrace", "locks", "obs", "perf", "mem",
-              "trace")
+              "trace", "chaos")
 
 
 def run_passes(passes, entry=None):
@@ -122,6 +125,10 @@ def run_passes(passes, entry=None):
         t0 = time.time()
         record("trace", analysis.run_tracehazard())
         timings["trace"] = time.time() - t0
+    if "chaos" in passes and entry is None:
+        t0 = time.time()
+        record("chaos", analysis.run_chaos())
+        timings["chaos"] = time.time() - t0
     return findings, timings, counts
 
 
@@ -436,6 +443,34 @@ def self_test() -> int:
     else:
         print("  [ok] bad_collective_axis.py: both axis arms fire")
 
+    # --- pass 8: chaos-recovery budget fixtures ---
+    from combblas_tpu.analysis import chaosbudget
+
+    print("fixture: bad_chaos_budget.json")
+    fs = chaosbudget.run_chaos(files=[fx / "bad_chaos_budget.json"],
+                               root=fx)
+    expect("chaos budget overshoot", {f.rule for f in fs},
+           core.CHAOS_UNRESOLVED, core.CHAOS_SHED, core.CHAOS_BIT_EXACT,
+           core.CHAOS_RECOVERY, core.CHAOS_STALE)
+    # the waived entry must be suppressed: exactly ONE shed-budget
+    # finding survives (the unwaived one), not two
+    sheds = [f for f in fs if f.rule == core.CHAOS_SHED]
+    if len(sheds) != 1:
+        failures.append(f"bad_chaos_budget.json: expected exactly 1 "
+                        f"surviving shed-budget finding (the waived "
+                        f"entry suppressed), got {len(sheds)}")
+    else:
+        print("  [ok] bad_chaos_budget.json: allow-list honored")
+    # resolved against the repo root the fixture artifact is absent:
+    # the missing-artifact arm of chaos-stale-artifact must fire
+    missing = chaosbudget.run_chaos(files=[fx / "bad_chaos_budget.json"])
+    if not any(f.rule == core.CHAOS_STALE and "not found" in f.message
+               for f in missing):
+        failures.append("bad_chaos_budget.json: missing artifact did "
+                        "not flag chaos-stale-artifact")
+    else:
+        print("  [ok] bad_chaos_budget.json: missing artifact flagged")
+
     if failures:
         print("\nSELF-TEST FAILED:")
         for f in failures:
@@ -458,7 +493,7 @@ def main() -> int:
     ap.add_argument("--passes",
                     default=",".join(ALL_PASSES),
                     help="comma list of budgets,retrace,locks,obs,"
-                         "perf,mem,trace")
+                         "perf,mem,trace,chaos")
     ap.add_argument("--entry", default=None,
                     help="restrict the budget pass to one entry point")
     ap.add_argument("--diff", nargs="?", const="HEAD", default=None,

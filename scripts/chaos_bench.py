@@ -1,0 +1,346 @@
+#!/usr/bin/env python
+"""Chaos soak harness: drive the committed fault schedule against a
+live serving workload and record the recovery invariants the chaos
+budget gates.
+
+    PYTHONPATH=/root/repo python scripts/chaos_bench.py
+
+Phases (one artifact, CHAOS_r01.json at the repo root by default):
+
+1. **reference** — a fault-free serve workload (mixed BFS/CC queries
+   through `serve.GraphService`) establishing the canonical results
+   and proving the harness itself is clean;
+2. **clean SpGEMM** — one phased A*A, the reference product for the
+   degradation arm;
+3. **faulted** — arm `scripts/chaos_schedule.json` through
+   `resilience.faults` and re-run BOTH workloads: transient dispatch
+   faults and injected latency land on the serve sites (recovered by
+   the engine's retry-with-backoff), an injected RESOURCE_EXHAUSTED
+   lands on the first phased-SpGEMM dispatch (recovered by the window
+   budget degradation loop), and stuck deferred nnz readbacks force
+   the CapLadder-rung fallback. Every handle must resolve — a future
+   that never completes is the one unrecoverable outcome;
+4. **cleared** — disarm and re-run the serve mix on the SAME service:
+   results must match the reference bit-exactly (no poisoned caches,
+   no stuck breaker, no lost worker);
+5. **checkpoint/resume** — an MCL run checkpointed every 2 iterations,
+   then resumed from its newest mid-run checkpoint: labels, cluster
+   count and total iteration count must match the uninterrupted run.
+
+The artifact carries the strict bench schema (`dispatch_summary` +
+`unaccounted_s`, so `bench_registry.py --check` grades it "full") plus
+a `chaos_summary` block that `analysis/chaosbudget.py` (pass 8) holds
+against `analysis/budgets/chaos.json`. The roofline efficiency join is
+deliberately nulled: injected latency and re-dispatched retries make
+the wall/bound ratio meaningless for a chaos run, and the perf gate's
+floors skip null values by design.
+"""
+
+import argparse
+import json
+import math
+import os
+import pathlib
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+DEFAULT_SCHEDULE = pathlib.Path(__file__).resolve().parent / \
+    "chaos_schedule.json"
+
+
+def _cpu_env():
+    """Standalone runs use the tests' backend: CPU, 8 virtual devices,
+    x64 off (same as scripts/analyze.py)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = flags
+
+
+def _mix(n: int, queries: int) -> list:
+    """Deterministic query mix: alternating BFS roots and CC vertices
+    spread over the vertex range."""
+    return [("bfs", (i * 7) % n) if i % 2 == 0 else ("cc", (i * 5) % n)
+            for i in range(queries)]
+
+
+def _canon(res):
+    """Comparable form of one serve result (BfsResult or CC label)."""
+    import numpy as np
+    if hasattr(res, "parents"):
+        return ("bfs", res.root, np.asarray(res.parents).tobytes())
+    return ("cc", int(res))
+
+
+def _run_mix(svc, mix, timeout_s: float):
+    """Submit the whole mix, then drain every handle. A handle that
+    raises is RESOLVED (the failure surfaced); only a `result()`
+    timeout counts as unresolved — the hang the supervision layer
+    exists to prevent."""
+    handles = []
+    admission_failed = 0
+    for kind, arg in mix:
+        try:
+            h = (svc.submit_bfs(arg) if kind == "bfs"
+                 else svc.submit_cc(arg))
+        except Exception:
+            handles.append(None)
+            admission_failed += 1
+            continue
+        handles.append(h)
+    results, ok, failed, unresolved = [], 0, admission_failed, 0
+    for h in handles:
+        if h is None:
+            results.append(None)
+            continue
+        try:
+            results.append(_canon(h.result(timeout=timeout_s)))
+            ok += 1
+        except TimeoutError:
+            results.append(None)
+            unresolved += 1
+        except Exception:
+            results.append(None)
+            failed += 1
+    return results, ok, failed, unresolved
+
+
+def _spgemm_triples(cm):
+    """Canonical lexsorted COO triples of a 1x1-grid product."""
+    import numpy as np
+    k = int(np.asarray(cm.nnz[0, 0]))
+    rows = np.asarray(cm.rows[0, 0])[:k]
+    cols = np.asarray(cm.cols[0, 0])[:k]
+    vals = np.asarray(cm.vals[0, 0])[:k]
+    order = np.lexsort((cols, rows))
+    return rows[order], cols[order], vals[order]
+
+
+def _triples_equal(a, b):
+    import numpy as np
+    return len(a) == len(b) and all(
+        np.array_equal(x, y) for x, y in zip(a, b))
+
+
+def run_chaos(out_dir=None, n: int = 256, queries: int = 64,
+              seed: int = 11, schedule=None, timeout_s: float = 300.0,
+              artifact_name: str = "CHAOS_r01.json") -> dict:
+    """Run the full soak; writes `artifact_name` under `out_dir`
+    (default: repo root) and returns the artifact dict."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs, serve
+    from combblas_tpu.models import mcl as M
+    from combblas_tpu.obs import memledger
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm
+    from combblas_tpu.parallel import spgemm as spg
+    from combblas_tpu.parallel.grid import ProcGrid
+    from combblas_tpu.resilience import faults
+    from combblas_tpu.utils.config import ServeConfig
+
+    out_dir = pathlib.Path(out_dir) if out_dir is not None else REPO
+    out_dir.mkdir(parents=True, exist_ok=True)
+    sched_path = pathlib.Path(schedule or DEFAULT_SCHEDULE)
+    sched = json.loads(sched_path.read_text())
+    sched["seed"] = int(seed)
+    scale = max(1, int(round(math.log2(n))))
+
+    grid = ProcGrid.make(1, 1, jax.devices()[:1])
+    t_start = time.perf_counter()
+    memledger.reset()
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.costmodel.reset()
+    obs.set_enabled(True)
+    try:
+        # ---- serve workload: reference -------------------------------
+        r, c = generate.rmat_edges(jax.random.key(seed), scale, 8)
+        r, c = generate.symmetrize(r, c)
+        a = dm.from_global_coo(S.LOR, grid, r, c,
+                               jnp.ones_like(r, jnp.bool_), n, n)
+        cfg = ServeConfig(buckets=(1, 2, 4), batch_wait_s=0.0,
+                          default_deadline_s=None,
+                          max_queue_depth=max(512, 4 * queries),
+                          retry_max_attempts=3, breaker_threshold=8,
+                          breaker_recovery_s=0.05)
+        mix = _mix(n, queries)
+        svc = serve.GraphService(a, cfg)
+        try:
+            ref, ok0, failed0, unres0 = _run_mix(svc, mix, timeout_s)
+            if failed0 or unres0:
+                raise RuntimeError(
+                    f"fault-free reference phase failed ({failed0} "
+                    f"failed, {unres0} unresolved) — the harness "
+                    "itself is broken, nothing to soak")
+
+            # ---- clean SpGEMM reference ------------------------------
+            rf, cf = generate.rmat_edges(jax.random.key(seed + 1),
+                                         scale, 8)
+            af = dm.from_global_coo(S.PLUS, grid, rf, cf,
+                                    jnp.ones_like(rf, jnp.float32), n, n)
+            t_ref = _spgemm_triples(
+                spg.spgemm_phased(S.PLUS_TIMES_F32, af, af, phases=3))
+
+            # ---- faulted phase ---------------------------------------
+            with svc._stats_lock:
+                before = dict(svc.stats)
+            inj = faults.FaultInjector(sched)
+            faults.arm(inj)
+            try:
+                _, ok1, failed1, unres1 = _run_mix(svc, mix, timeout_s)
+                t_faulted = _spgemm_triples(
+                    spg.spgemm_phased(S.PLUS_TIMES_F32, af, af, phases=3))
+            finally:
+                faults.disarm()
+            inj_stats = inj.stats()
+            with svc._stats_lock:
+                after = dict(svc.stats)
+            spgemm_exact = _triples_equal(t_faulted, t_ref)
+
+            # ---- cleared phase: same service, same mix ---------------
+            time.sleep(2 * cfg.breaker_recovery_s)
+            clr, ok2, failed2, unres2 = _run_mix(svc, mix, timeout_s)
+            bit_exact = (clr == ref and failed2 == 0 and unres2 == 0)
+            varz = svc._varz()
+        finally:
+            svc.stop()
+
+        # ---- MCL checkpoint/resume parity (faults cleared) -----------
+        rngm = np.random.default_rng(seed)
+        nm = 90
+        rows, cols = [], []
+        for blob in range(3):
+            lo, hi = blob * 30, (blob + 1) * 30
+            rows.append(rngm.integers(lo, hi, 240))
+            cols.append(rngm.integers(lo, hi, 240))
+        rm, cm_ = np.concatenate(rows), np.concatenate(cols)
+        am = dm.from_global_coo(
+            S.PLUS, grid, np.concatenate([rm, cm_]),
+            np.concatenate([cm_, rm]),
+            np.ones(2 * len(rm), np.float32), nm, nm)
+        params = M.MclParams(max_iters=25)
+        with tempfile.TemporaryDirectory() as td:
+            pfx = pathlib.Path(td) / "mcl_ckpt"
+            lab1, nc1, it1 = M.mcl(am, params, checkpoint_path=pfx,
+                                   checkpoint_every=2)
+            lab2, nc2, it2 = M.mcl(am, params, checkpoint_path=pfx,
+                                   checkpoint_every=2, resume=True)
+        ckpt_exact = (np.array_equal(np.asarray(lab1.to_global()),
+                                     np.asarray(lab2.to_global()))
+                      and (nc2, it2) == (nc1, it1))
+
+        wall = time.perf_counter() - t_start
+        ds = obs.export.dispatch_summary()
+        # roofline join is meaningless under injected latency/retries;
+        # the perf gate's efficiency floors skip null values by design
+        ds["efficiency"] = None
+        ms = obs.export.memory_summary()
+        unacc = float(obs.export.unaccounted_s())
+    finally:
+        faults.disarm()
+        obs.set_enabled(False)
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+        obs.costmodel.reset()
+        memledger.reset()
+
+    recovered_frac = ok1 / max(queries, 1)
+    shed = int(after["shed"]) - int(before["shed"])
+    art = {
+        "metric": "chaos_recovery_frac",
+        "value": round(recovered_frac, 4),
+        "unit": "frac",
+        "scale": scale,
+        "n": n,
+        "queries": queries,
+        "grid": "1x1",
+        "platform": jax.default_backend(),
+        "wall_s": round(wall, 4),
+        "unaccounted_s": round(unacc, 4),
+        "chaos_summary": {
+            "seed": int(seed),
+            "schedule": str(sched_path.relative_to(REPO)
+                            if sched_path.is_relative_to(REPO)
+                            else sched_path.name),
+            "faults_injected": int(sum(inj_stats["injected"].values())),
+            "faults_by_kind": inj_stats["injected"],
+            "rules": inj_stats["rules"],
+            "queries_total": queries,
+            "queries_ok_faulted": ok1,
+            "queries_failed_faulted": failed1,
+            "unresolved_handles": unres0 + unres1 + unres2,
+            "shed": shed,
+            "shed_frac": round(shed / max(queries, 1), 4),
+            "recovered_frac": round(recovered_frac, 4),
+            "retries": int(after["retries"]) - int(before["retries"]),
+            "worker_restarts": int(after["worker_restarts"]),
+            "breakers": varz["resilience"]["breakers"],
+            "bit_exact_after_clear": bool(bit_exact),
+            "spgemm_faulted_bit_exact": bool(spgemm_exact),
+            "checkpoint_resume_exact": bool(ckpt_exact),
+            "mcl_iterations": int(it1),
+            "mcl_clusters": int(nc1),
+        },
+        "dispatch_summary": ds,
+        "memory_summary": ms,
+        "note": (
+            "chaos soak: mixed BFS/CC serve traffic + phased SpGEMM + "
+            "MCL checkpoint/resume under the committed fault schedule. "
+            "value = fraction of faulted-phase queries that still "
+            "succeeded (retry/degradation recovered them). The "
+            "dispatch_summary efficiency block is nulled on purpose: "
+            "injected latency and re-dispatched retries make the "
+            "roofline verdict meaningless for this run."),
+    }
+    out_path = out_dir / artifact_name
+    out_path.write_text(json.dumps(art, indent=1, sort_keys=True) + "\n")
+    return art
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="chaos_bench",
+        description="chaos soak: fault-injected serve/SpGEMM/MCL "
+                    "workload -> CHAOS_rNN.json recovery artifact")
+    ap.add_argument("--n", type=int, default=256,
+                    help="vertex count of the served graph")
+    ap.add_argument("--queries", type=int, default=64,
+                    help="queries per serve phase")
+    ap.add_argument("--seed", type=int, default=11,
+                    help="schedule seed (overrides the committed one)")
+    ap.add_argument("--schedule", default=None,
+                    help="fault schedule JSON (default: "
+                         "scripts/chaos_schedule.json)")
+    ap.add_argument("--out-dir", default=None,
+                    help="artifact directory (default: repo root)")
+    ap.add_argument("--artifact", default="CHAOS_r01.json",
+                    help="artifact file name")
+    args = ap.parse_args(argv)
+    _cpu_env()
+    art = run_chaos(out_dir=args.out_dir, n=args.n, queries=args.queries,
+                    seed=args.seed, schedule=args.schedule,
+                    artifact_name=args.artifact)
+    cs = art["chaos_summary"]
+    print(json.dumps(cs, indent=1, sort_keys=True))
+    ok = (cs["unresolved_handles"] == 0 and cs["bit_exact_after_clear"]
+          and cs["spgemm_faulted_bit_exact"]
+          and cs["checkpoint_resume_exact"]
+          and cs["faults_injected"] > 0)
+    print(f"chaos soak: {'OK' if ok else 'FAILED'} — "
+          f"{cs['faults_injected']} fault(s) injected, "
+          f"{cs['unresolved_handles']} unresolved handle(s), "
+          f"recovered {cs['recovered_frac']:.0%}, "
+          f"wall {art['wall_s']:.1f}s -> {args.artifact}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

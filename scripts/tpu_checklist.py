@@ -43,6 +43,14 @@ Steps (each standalone, continues past failures):
      90% of in-wrapper compiles, the donation audit must report zero
      unhonored donations against THIS backend's executables, and the
      memory_summary block must carry its hbm_bytes capacity verdict.
+  0h. (--chaos) resilience smoke: a miniature chaos soak
+     (scripts/chaos_bench.py) against THIS backend — the committed
+     fault schedule injected into a live serve mix, a phased SpGEMM,
+     and an MCL checkpoint/resume pair; every future must resolve,
+     results must be bit-exact once faults clear, and the soak must
+     actually inject faults (a vacuous soak proves nothing). Proves
+     the recovery paths the chaos budget gates work on this backend
+     before any long unsupervised step runs.
   1. Pallas segmented-scan kernel: compile + compare vs the XLA path
      on real tile data; report speedup at BFS-like sizes.
   2. BFS quick bench at scale 20 (round-over-round comparison point),
@@ -501,6 +509,57 @@ def run_mem_check(grid) -> bool:
     return ok
 
 
+def run_chaos_check() -> bool:
+    """Step 0h: resilience smoke — a miniature chaos soak through
+    scripts/chaos_bench.py on this backend. The committed fault
+    schedule must inject, every submitted future must resolve, the
+    same service must return bit-exact results once faults clear, the
+    fault-recovered SpGEMM must match the clean product, and a
+    resumed MCL must match its uninterrupted run."""
+    import importlib.util
+    import tempfile
+
+    here = pathlib.Path(__file__).resolve().parent
+    spec = importlib.util.spec_from_file_location(
+        "chaos_bench", here / "chaos_bench.py")
+    chaos_bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_bench)
+
+    step("0h. resilience / chaos smoke (--chaos)")
+    ok = True
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            art = chaos_bench.run_chaos(out_dir=pathlib.Path(td),
+                                        n=64, queries=12, seed=7)
+        cs = art["chaos_summary"]
+        print(f"  faults={cs['faults_injected']} "
+              f"by_kind={cs['faults_by_kind']} "
+              f"retries={cs['retries']} shed={cs['shed']} "
+              f"recovered={cs['recovered_frac']:.0%}")
+        if not cs["faults_injected"]:
+            print("FAIL: the committed schedule injected ZERO faults "
+                  "— the soak is vacuous on this backend")
+            ok = False
+        if cs["unresolved_handles"]:
+            print(f"FAIL: {cs['unresolved_handles']} future(s) never "
+                  "resolved — supervision let a request hang")
+            ok = False
+        for key, what in (
+                ("bit_exact_after_clear", "serve results after faults "
+                                          "cleared"),
+                ("spgemm_faulted_bit_exact", "fault-recovered SpGEMM"),
+                ("checkpoint_resume_exact", "resumed MCL")):
+            if not cs[key]:
+                print(f"FAIL: {what} diverged from the fault-free "
+                      "reference")
+                ok = False
+    except Exception:
+        traceback.print_exc()
+        return False
+    print("chaos smoke:", "OK" if ok else "FAILED")
+    return ok
+
+
 def run_mesh_check() -> bool:
     """Step 0e: scale-out smoke on a 2x2 submesh — the serve bits
     path must resolve (not fall back) on a routed square mesh, the
@@ -630,6 +689,11 @@ def main():
                          "the footprint census on; census coverage "
                          ">= 90%%, zero unhonored donations, capacity "
                          "verdict present")
+    ap.add_argument("--chaos", action="store_true",
+                    help="resilience smoke: miniature chaos soak "
+                         "(scripts/chaos_bench.py) — committed fault "
+                         "schedule injected, zero unresolved futures, "
+                         "bit-exact recovery on this backend")
     args = ap.parse_args()
     if args.analysis and not run_analysis_gate():
         sys.exit(1)
@@ -658,6 +722,8 @@ def main():
     if args.mesh and not run_mesh_check():
         sys.exit(1)
     if args.mem and not run_mem_check(grid):
+        sys.exit(1)
+    if args.chaos and not run_chaos_check():
         sys.exit(1)
 
     step("1. pallas scan on-chip")

@@ -1,4 +1,4 @@
-"""Static-analysis gate (combblas_tpu.analysis): the seven passes run
+"""Static-analysis gate (combblas_tpu.analysis): the eight passes run
 clean on the merged tree, each rule demonstrably FIRES on its
 committed bad-pattern fixture under tests/fixtures/analysis/, and the
 retrace signature model agrees with jax's actual compile behavior.
@@ -481,7 +481,7 @@ def test_bits_ladder_folds_to_one_signature():
 
 def test_run_all_selected_passes_clean():
     assert analysis.run_all(passes=("retrace", "locks", "obs",
-                                    "perf", "trace")) == []
+                                    "perf", "trace", "chaos")) == []
 
 
 def test_cli_gate_exit_codes():
@@ -491,7 +491,7 @@ def test_cli_gate_exit_codes():
     finds violations (driven via the self-test fixtures)."""
     r = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "analyze.py"),
-         "--gate", "--passes", "locks,retrace,obs,perf,trace"],
+         "--gate", "--passes", "locks,retrace,obs,perf,trace,chaos"],
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "PASS" in r.stdout

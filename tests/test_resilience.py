@@ -1,0 +1,629 @@
+"""Resilience layer: deterministic fault injection, donation-aware
+retry, circuit breaker, serve supervision, SpGEMM degradation paths,
+and solver checkpoint/resume.
+
+Compile discipline: device-touching tests run on a 1x1 grid (one CPU
+device) with tiny graphs — the chaos soak that exercises the full
+stack at width is `scripts/chaos_bench.py` (marked slow here). The
+injector/retry/breaker units are pure host work.
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel.grid import ProcGrid
+from combblas_tpu.resilience import breaker as rbr
+from combblas_tpu.resilience import checkpoint as ck
+from combblas_tpu.resilience import faults
+from combblas_tpu.resilience import retry as rrt
+
+
+@pytest.fixture(scope="module")
+def grid1(devices):
+    return ProcGrid.make(1, 1, devices[:1])
+
+
+# ---------------------------------------------------------------------------
+# fault injector: determinism, triggers, kinds
+# ---------------------------------------------------------------------------
+
+class TestFaultInjector:
+    def test_at_trigger_fires_on_exact_call_index(self):
+        inj = faults.FaultInjector(
+            {"rules": [{"match": "x.*", "kind": "transient", "at": [2]}]})
+        for k in range(5):
+            if k == 2:
+                with pytest.raises(faults.TransientFault):
+                    inj.before_dispatch("x.site")
+            else:
+                inj.before_dispatch("x.site")
+        assert inj.stats()["injected"]["transient"] == 1
+
+    def test_every_and_after_and_max(self):
+        inj = faults.FaultInjector(
+            {"rules": [{"match": "*", "kind": "transient", "every": 2,
+                        "after": 2, "max": 2}]})
+        fired = []
+        for k in range(12):
+            try:
+                inj.before_dispatch("s")
+            except faults.TransientFault:
+                fired.append(k)
+        # counter advances from call 0; every=2 fires on odd ordinals,
+        # after=2 skips the first two calls, max=2 caps the total
+        assert fired == [3, 5]
+
+    def test_p_trigger_is_deterministic_across_replays(self):
+        sched = {"seed": 11, "rules": [
+            {"match": "*", "kind": "transient", "p": 0.4}]}
+
+        def run():
+            inj = faults.FaultInjector(sched)
+            out = []
+            for _ in range(32):
+                try:
+                    inj.before_dispatch("site.a")
+                    out.append(0)
+                except faults.TransientFault:
+                    out.append(1)
+            return out
+
+        a, b = run(), run()
+        assert a == b
+        assert 0 < sum(a) < 32     # p=0.4 over 32 draws: not degenerate
+
+    def test_different_sites_do_not_share_ordinals(self):
+        inj = faults.FaultInjector(
+            {"rules": [{"match": "*", "kind": "transient", "at": [0]}]})
+        with pytest.raises(faults.TransientFault):
+            inj.before_dispatch("a")
+        # site "b" has its own call counter -> its call 0 also fires
+        with pytest.raises(faults.TransientFault):
+            inj.before_dispatch("b")
+
+    def test_oom_is_resource_exhausted_shaped(self):
+        inj = faults.FaultInjector(
+            {"rules": [{"match": "*", "kind": "oom", "at": [0]}]})
+        with pytest.raises(faults.InjectedOom) as ei:
+            inj.before_dispatch("mcl.megastep")
+        assert "RESOURCE_EXHAUSTED" in str(ei.value)
+        assert faults.is_oom_error(ei.value)
+        assert faults.is_transient(ei.value)
+
+    def test_latency_sleeps(self):
+        inj = faults.FaultInjector(
+            {"rules": [{"match": "*", "kind": "latency", "at": [0],
+                        "latency_s": 0.05}]})
+        t0 = time.perf_counter()
+        inj.before_dispatch("s")
+        assert time.perf_counter() - t0 >= 0.04
+
+    def test_nan_poisons_float_leaves_only(self):
+        inj = faults.FaultInjector(
+            {"rules": [{"match": "*", "kind": "nan", "at": [0]}]})
+        out = inj.after_dispatch(
+            "s", (jnp.ones(3, jnp.float32), jnp.arange(3, dtype=jnp.int32)))
+        assert bool(jnp.isnan(out[0]).all())
+        np.testing.assert_array_equal(np.asarray(out[1]), [0, 1, 2])
+
+    def test_rule_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            faults.FaultInjector(
+                {"rules": [{"match": "*", "kind": "transient"}]})
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            faults.FaultInjector(
+                {"rules": [{"match": "*", "kind": "meteor", "at": [0]}]})
+
+    def test_armed_hook_intercepts_instrumented_calls(self):
+        from combblas_tpu import obs
+        fn = obs.instrument(lambda x: x + 1, "resil.test_site")
+        with faults.injected({"rules": [
+                {"match": "resil.test_*", "kind": "transient",
+                 "at": [0]}]}) as inj:
+            with pytest.raises(faults.TransientFault):
+                fn(1)
+            assert fn(1) == 2
+        assert inj.stats()["injected"]["transient"] == 1
+        assert fn(1) == 2          # disarmed: hook gone
+
+
+# ---------------------------------------------------------------------------
+# retry: classification, budget, deadline, factory re-materialization
+# ---------------------------------------------------------------------------
+
+class TestRetry:
+    def test_recovers_after_transients(self):
+        calls = []
+
+        def make(attempt):
+            def run():
+                calls.append(attempt)
+                if len(calls) < 3:
+                    raise faults.TransientFault("flaky")
+                return "ok"
+            return run
+
+        pol = rrt.RetryPolicy(max_attempts=4, backoff_s=0.001)
+        assert rrt.retry_call(make, policy=pol) == "ok"
+        # the factory saw a fresh 1-based attempt number each time
+        assert calls == [1, 2, 3]
+
+    def test_permanent_raises_original_type_immediately(self):
+        calls = []
+
+        def make(attempt):
+            def run():
+                calls.append(attempt)
+                raise ValueError("bad shape")
+            return run
+
+        with pytest.raises(ValueError, match="bad shape"):
+            rrt.retry_call(make, policy=rrt.RetryPolicy(max_attempts=5))
+        assert calls == [1]
+
+    def test_exhausted_raises_budget_error_with_cause(self):
+        def make(attempt):
+            def run():
+                raise faults.TransientFault("always")
+            return run
+
+        pol = rrt.RetryPolicy(max_attempts=2, backoff_s=0.001)
+        with pytest.raises(rrt.RetryBudgetExceeded) as ei:
+            rrt.retry_call(make, policy=pol, name="t")
+        assert isinstance(ei.value.__cause__, faults.TransientFault)
+        # the give-up is NOT classified transient: no retry-the-retrier
+        assert not faults.is_transient(ei.value)
+
+    def test_deadline_blocks_further_attempts(self):
+        calls = []
+
+        def make(attempt):
+            def run():
+                calls.append(attempt)
+                raise faults.TransientFault("always")
+            return run
+
+        pol = rrt.RetryPolicy(max_attempts=10, backoff_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(rrt.RetryBudgetExceeded):
+            rrt.retry_call(make, policy=pol,
+                           deadline=time.monotonic() + 0.01)
+        assert calls == [1]                   # no room for the backoff
+        assert time.monotonic() - t0 < 0.15   # gave up, did not sleep
+
+    def test_backoff_schedule_is_deterministic(self):
+        pol = rrt.RetryPolicy(max_attempts=5, backoff_s=0.02,
+                              backoff_mult=2.0, max_backoff_s=0.05)
+        assert [pol.backoff_for(i) for i in (1, 2, 3, 4, 5)] == \
+            [0.0, 0.02, 0.04, 0.05, 0.05]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+class TestBreaker:
+    def test_full_cycle(self):
+        now = [0.0]
+        br = rbr.CircuitBreaker("k", failure_threshold=2, recovery_s=1.0,
+                                half_open_max=1, clock=lambda: now[0])
+        assert br.allow() and br.state == rbr.CLOSED
+        br.record_failure()
+        assert br.state == rbr.CLOSED         # streak 1 < threshold
+        br.record_failure()
+        assert br.state == rbr.OPEN
+        assert not br.allow()
+        now[0] = 1.5
+        assert br.state == rbr.HALF_OPEN
+        assert br.allow()                     # the single probe
+        assert not br.allow()                 # over half_open_max
+        br.record_failure()                   # probe failed -> re-open
+        assert br.state == rbr.OPEN
+        now[0] = 3.0
+        assert br.allow()                     # half-open again
+        br.record_success()
+        assert br.state == rbr.CLOSED
+        assert br.snapshot()["trips"] == 1
+
+    def test_success_resets_streak(self):
+        br = rbr.CircuitBreaker("k", failure_threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == rbr.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# plan cache: a failing build must not poison the entry (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestPlanCacheFailure:
+    def test_failed_build_leaves_no_entry_and_next_caller_rebuilds(self):
+        from combblas_tpu.serve.plans import PlanCache, PlanKey
+        cache = PlanCache()
+        key = PlanKey("bfs", "-", 1, (1, 1))
+
+        def bad():
+            raise RuntimeError("compile exploded")
+
+        with pytest.raises(RuntimeError, match="compile exploded"):
+            cache.get_or_build(key, bad)
+        assert len(cache) == 0
+        fn = cache.get_or_build(key, lambda: (lambda: "built"))
+        assert fn() == "built"
+        assert len(cache) == 1
+
+    def test_single_flight_waiter_gets_the_exception(self):
+        from combblas_tpu.serve.plans import PlanCache, PlanKey
+        cache = PlanCache()
+        key = PlanKey("cc", "-", 1, (1, 1))
+        entered = threading.Event()
+        release = threading.Event()
+
+        def slow_bad():
+            entered.set()
+            release.wait(5)
+            raise RuntimeError("compile exploded")
+
+        lead_err, wait_err = [], []
+
+        def lead():
+            try:
+                cache.get_or_build(key, slow_bad)
+            except RuntimeError as e:
+                lead_err.append(e)
+
+        def waiter():
+            entered.wait(5)
+            release.set()
+            try:
+                cache.get_or_build(
+                    key, lambda: pytest.fail("waiter must not build"))
+            except RuntimeError as e:
+                wait_err.append(e)
+
+        t1 = threading.Thread(target=lead)
+        t2 = threading.Thread(target=waiter)
+        t1.start(); t2.start(); t1.join(10); t2.join(10)
+        assert lead_err and wait_err
+        assert "compile exploded" in str(wait_err[0])
+        # slot is clean: a later caller rebuilds
+        assert cache.get_or_build(key, lambda: (lambda: 7))() == 7
+
+
+# ---------------------------------------------------------------------------
+# checkpoint surface
+# ---------------------------------------------------------------------------
+
+class TestCheckpoint:
+    def test_read_meta_missing_or_torn(self, tmp_path):
+        assert ck.read_meta(tmp_path / "nope") is None
+        # torn save: payload exists, meta (the commit point) does not
+        (tmp_path / "torn.a.npz").write_bytes(b"x")
+        assert ck.read_meta(tmp_path / "torn") is None
+        (tmp_path / "bad.meta.json").write_text("{not json")
+        assert ck.read_meta(tmp_path / "bad") is None
+
+    def test_mcl_roundtrip_preserves_matrix_and_meta(self, grid1,
+                                                     tmp_path, rng):
+        n, m = 48, 100
+        a = DM.from_global_coo(
+            S.PLUS, grid1, rng.integers(0, n, m), rng.integers(0, n, m),
+            rng.normal(size=m).astype(np.float32), n, n)
+        pfx = tmp_path / "mck"
+        ck.save_mcl(pfx, a, it=5, cap_pin=int(a.cap), rungs=[256, 1024])
+        b, meta = ck.load_mcl(S.PLUS, grid1, pfx)
+        assert meta["it"] == 5 and meta["rungs"] == [256, 1024]
+        assert b.cap == a.cap
+        ra, ca, va = DM.to_global_coo(a)
+        rb, cb, vb = DM.to_global_coo(b)
+        np.testing.assert_array_equal(ra, rb)
+        np.testing.assert_array_equal(ca, cb)
+        np.testing.assert_array_equal(va, vb)
+
+    def test_wrong_solver_refuses(self, grid1, tmp_path):
+        f = jnp.arange(8, dtype=jnp.int32)
+        ck.save_fastsv(tmp_path / "sv", grid1, f, f, it=1, glen=8)
+        with pytest.raises(FileNotFoundError):
+            ck.load_mcl(S.PLUS, grid1, tmp_path / "sv")
+        f2, gf2, meta = ck.load_fastsv(grid1, tmp_path / "sv")
+        np.testing.assert_array_equal(np.asarray(f2), np.asarray(f))
+        assert meta["it"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SpGEMM: stuck-readback fallback (satellite 3) + OOM degradation
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spgemm_operands(grid1):
+    rng = np.random.default_rng(5)
+    n, m = 192, 2500
+
+    def mk(seed):
+        r = np.random.default_rng(seed)
+        return DM.from_global_coo(
+            S.PLUS, grid1, r.integers(0, n, m), r.integers(0, n, m),
+            r.standard_normal(m).astype(np.float32), n, n)
+
+    return mk(1), mk(2)
+
+
+def _run_phased(a, b):
+    c = spg.spgemm_phased(S.PLUS_TIMES_F32, a, b, phases=3)
+    r, co, v = DM.to_global_coo(c)
+    order = np.lexsort((co, r))
+    return r[order], co[order], v[order]
+
+
+@pytest.fixture(scope="module")
+def spgemm_oracle(spgemm_operands, monkeypatch_module):
+    """Reference product from the r05 blocking loop
+    (COMBBLAS_TPU_SYNC_WINDOWS=1): the async pipeline's bit-exactness
+    oracle (PR-7)."""
+    a, b = spgemm_operands
+    monkeypatch_module.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "1")
+    ref = _run_phased(a, b)
+    monkeypatch_module.delenv("COMBBLAS_TPU_SYNC_WINDOWS")
+    return ref
+
+
+@pytest.fixture(scope="module")
+def monkeypatch_module():
+    mp = pytest.MonkeyPatch()
+    yield mp
+    mp.undo()
+
+
+class TestSpgemmResilience:
+    def test_stuck_deferred_readback_takes_capladder_fallback(
+            self, spgemm_operands, spgemm_oracle):
+        """PR-7's fallback branch under fire: every deferred nnz count
+        is held hostage (never reports ready), so every window must be
+        placed at its CapLadder rung unshrunk — and the product must
+        still match the blocking oracle bit-for-bit."""
+        a, b = spgemm_operands
+        with faults.injected({"rules": [
+                {"match": "spgemm.nnz_deferred", "kind": "stuck",
+                 "every": 1}]}) as inj:
+            got = _run_phased(a, b)
+        assert inj.stats()["injected"]["stuck"] > 0
+        for x, y in zip(spgemm_oracle, got):
+            np.testing.assert_array_equal(x, y)
+
+    def test_injected_oom_degrades_and_recovers_bit_exactly(
+            self, spgemm_operands, spgemm_oracle):
+        a, b = spgemm_operands
+        with faults.injected({"rules": [
+                {"match": "spgemm.*", "kind": "oom", "at": [0],
+                 "max": 1}]}) as inj:
+            got = _run_phased(a, b)
+        assert inj.stats()["injected"]["oom"] == 1
+        for x, y in zip(spgemm_oracle, got):
+            np.testing.assert_array_equal(x, y)
+
+    def test_oom_at_floor_surfaces(self):
+        calls = []
+
+        def boom(**kw):
+            calls.append(kw["phase_flop_budget"])
+            raise faults.InjectedOom("always")
+
+        orig = spg._phased_1x1_run
+        spg._phased_1x1_run = lambda *a, **kw: boom(**kw)
+        try:
+            with pytest.raises(faults.InjectedOom):
+                spg._phased_1x1(
+                    S.PLUS_TIMES_F32, None, None, phases=None,
+                    phase_flop_budget=1 << 22, prune_hook=None,
+                    out_cap=None, cap_round=128)
+        finally:
+            spg._phased_1x1_run = orig
+        # budgets decayed monotonically to the floor, then gave up
+        assert calls[0] == 1 << 22
+        assert all(x > y for x, y in zip(calls, calls[1:]))
+        assert calls[-1] == spg._OOM_BUDGET_FLOOR
+
+
+# ---------------------------------------------------------------------------
+# serve: worker supervision, breaker, retry (tentpole c/d)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_graph(grid1):
+    rng = np.random.default_rng(9)
+    n, m = 96, 220
+    r = rng.integers(0, n, m)
+    c = rng.integers(0, n, m)
+    rows = np.concatenate([r, c]).astype(np.int32)
+    cols = np.concatenate([c, r]).astype(np.int32)
+    vals = np.ones(len(rows), np.float32)
+    return DM.from_global_coo(S.PLUS, grid1, rows, cols, vals, n, n), n
+
+
+def _mk_cfg(**kw):
+    from combblas_tpu.utils.config import ServeConfig
+    base = dict(buckets=(1, 2), batch_wait_s=0.0, default_deadline_s=None)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+class TestServeSupervision:
+    def test_crash_fails_queued_futures_fast_and_kills_service(
+            self, serve_graph):
+        from combblas_tpu import serve
+        a, n = serve_graph
+        svc = serve.GraphService(a, _mk_cfg(worker_max_restarts=0),
+                                 autostart=False)
+        h = svc.submit_cc(0)
+        svc.batcher.form = lambda: (_ for _ in ()).throw(
+            RuntimeError("batcher exploded"))
+        svc.start()
+        with pytest.raises(serve.WorkerCrashedError, match="failed fast"):
+            h.result(timeout=30)
+        # the supervisor exhausted its restart budget: service is dead
+        deadline = time.monotonic() + 10
+        while not svc._worker_dead and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert svc._varz()["healthy"] is False
+        assert svc._varz()["resilience"]["worker_dead"] is True
+        with pytest.raises(serve.WorkerCrashedError, match="refusing"):
+            svc.submit_cc(1)
+        svc.stop()
+
+    def test_restart_budget_keeps_serving_degraded(self, serve_graph):
+        from combblas_tpu import serve
+        a, n = serve_graph
+        svc = serve.GraphService(a, _mk_cfg(worker_max_restarts=2),
+                                 autostart=False)
+        orig_form = svc.batcher.form
+        blew = threading.Event()
+
+        def form_once_bad():
+            if not blew.is_set():
+                blew.set()
+                raise RuntimeError("transient batcher crash")
+            return orig_form()
+
+        svc.batcher.form = form_once_bad
+        h_doomed = svc.submit_cc(0)
+        svc.start()
+        with pytest.raises(serve.WorkerCrashedError):
+            h_doomed.result(timeout=30)
+        # restarted worker serves the NEXT request fine
+        label = svc.submit_cc(0).result(timeout=600)
+        assert isinstance(label, (int, np.integer))
+        vz = svc._varz()
+        assert vz["healthy"] is True
+        assert vz["resilience"]["degraded"] is True
+        assert vz["resilience"]["worker_restarts"] == 1
+        svc.stop()
+
+
+class TestServeRetryAndBreaker:
+    def test_transient_dispatch_retries_and_recovers(self, serve_graph):
+        from combblas_tpu import serve
+        a, n = serve_graph
+        svc = serve.GraphService(
+            a, _mk_cfg(retry_max_attempts=3, retry_backoff_s=0.001),
+            autostart=False)
+        h = svc.submit_cc(3)
+        with faults.injected({"rules": [
+                {"match": "serve.cc*", "kind": "transient", "at": [0],
+                 "max": 1}]}):
+            svc.start()
+            label = h.result(timeout=600)
+        assert isinstance(label, (int, np.integer))
+        assert svc._varz()["resilience"]["retries"] >= 1
+        svc.stop()
+
+    def test_breaker_opens_after_consecutive_failures(self, serve_graph):
+        from combblas_tpu import serve
+        a, n = serve_graph
+        svc = serve.GraphService(
+            a, _mk_cfg(retry_max_attempts=1, breaker_threshold=2,
+                       breaker_recovery_s=60.0),
+            autostart=True)
+        with faults.injected({"rules": [
+                {"match": "serve.cc*", "kind": "transient", "every": 1,
+                 "max": 50}]}):
+            for _ in range(2):
+                with pytest.raises(faults.TransientFault):
+                    svc.submit_cc(0).result(timeout=600)
+            # two consecutive dispatch failures tripped the cc breaker:
+            # the next request fails FAST, without touching the device
+            with pytest.raises(serve.CircuitOpenError):
+                svc.submit_cc(0).result(timeout=600)
+        vz = svc._varz()["resilience"]["breakers"]
+        assert vz["cc"]["state"] == "open"
+        assert vz["cc"]["trips"] == 1
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# solver checkpoint/resume (tentpole e)
+# ---------------------------------------------------------------------------
+
+class TestMclCheckpointResume:
+    def test_resume_matches_uninterrupted_run(self, grid1, tmp_path):
+        from combblas_tpu.models import mcl as M
+        rng = np.random.default_rng(3)
+        n = 90
+        rows, cols = [], []
+        for blob in range(3):
+            lo, hi = blob * 30, (blob + 1) * 30
+            rows.append(rng.integers(lo, hi, 240))
+            cols.append(rng.integers(lo, hi, 240))
+        r = np.concatenate(rows)
+        c = np.concatenate(cols)
+        a = DM.from_global_coo(
+            S.PLUS, grid1, np.concatenate([r, c]), np.concatenate([c, r]),
+            np.ones(2 * len(r), np.float32), n, n)
+        params = M.MclParams(max_iters=25)
+        pfx = tmp_path / "mclck"
+        lab1, nc1, it1 = M.mcl(a, params, checkpoint_path=pfx,
+                               checkpoint_every=2)
+        meta = ck.read_meta(pfx)
+        assert meta is not None and 0 < meta["it"] < it1
+        # resume mid-run: same labels, same cluster count, same TOTAL
+        # iteration count as the uninterrupted run
+        lab2, nc2, it2 = M.mcl(a, params, checkpoint_path=pfx,
+                               checkpoint_every=2, resume=True)
+        np.testing.assert_array_equal(np.asarray(lab1.to_global()),
+                                      np.asarray(lab2.to_global()))
+        assert (nc2, it2) == (nc1, it1)
+
+    def test_checkpoint_every_requires_path(self, grid1):
+        from combblas_tpu.models import mcl as M
+        a = DM.from_global_coo(S.PLUS, grid1, np.array([0]), np.array([0]),
+                               np.ones(1, np.float32), 4, 4)
+        with pytest.raises(ValueError, match="checkpoint_path"):
+            M.mcl(a, checkpoint_every=2)
+
+
+class TestFastsvCheckpointResume:
+    def test_chunked_and_resumed_match_single_shot(self, grid1, tmp_path):
+        from combblas_tpu.models import cc as C
+        n = 64
+        e = np.arange(n - 1, dtype=np.int32)   # path graph: many iters
+        rows = np.concatenate([e, e + 1])
+        cols = np.concatenate([e + 1, e])
+        a = DM.from_global_coo(S.PLUS, grid1, rows, cols,
+                               np.ones(len(rows), np.float32), n, n)
+        ref = np.asarray(C.fastsv(a).to_global())
+        pfx = tmp_path / "svck"
+        got = np.asarray(C.fastsv(a, checkpoint_path=pfx,
+                                  checkpoint_every=2).to_global())
+        np.testing.assert_array_equal(ref, got)
+        meta = ck.read_meta(pfx)
+        assert meta is not None and meta["solver"] == "fastsv"
+        got2 = np.asarray(C.fastsv(a, checkpoint_path=pfx,
+                                   checkpoint_every=2,
+                                   resume=True).to_global())
+        np.testing.assert_array_equal(ref, got2)
+
+
+# ---------------------------------------------------------------------------
+# chaos soak (the scripts/chaos_bench.py workload, shrunk) — slow
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_chaos_soak_smoke(tmp_path):
+    """End-to-end chaos harness: run the committed schedule against a
+    small serving workload and assert the recovery invariants the
+    chaos budget gates (zero unresolved handles, bounded shed, exact
+    results once faults clear)."""
+    import scripts.chaos_bench as cb
+    art = cb.run_chaos(out_dir=tmp_path, n=128, queries=24, seed=7)
+    assert art["chaos_summary"]["unresolved_handles"] == 0
+    assert art["chaos_summary"]["bit_exact_after_clear"] is True
+    assert art["chaos_summary"]["faults_injected"] > 0
