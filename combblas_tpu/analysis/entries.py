@@ -257,6 +257,26 @@ def _esc_hash_window():
                      jnp.asarray(40, jnp.int32))}
 
 
+@register("esc.block_window", "block-format window SpGEMM: monoid "
+          "scatter straight into the padded (bm, bn) block layout — "
+          "output STAYS in block form, so the budget pins ZERO sorts "
+          "(no COO compaction tail at all)")
+def _esc_block_window():
+    import jax.numpy as jnp
+
+    from combblas_tpu.ops import blocktile as BK
+    from combblas_tpu.ops import semiring as S
+    a, b = _tile_pair()
+
+    def fn(a, b, clo, chi):
+        return BK._spgemm_colwindow_block_impl(
+            S.PLUS_TIMES_F32, a, b, clo, chi, flops_cap=2048,
+            win_width=40, bm=8, bn=128, pallas_mode="off")
+    return {"fn": fn,
+            "args": (a, b, jnp.asarray(0, jnp.int32),
+                     jnp.asarray(40, jnp.int32))}
+
+
 # ---------------------------------------------------------------------------
 # entries: SpMV / SpMM
 # ---------------------------------------------------------------------------

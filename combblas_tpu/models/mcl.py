@@ -26,6 +26,7 @@ import numpy as np
 from combblas_tpu import obs
 from combblas_tpu.obs import metrics as obm
 from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile_algebra as talg
 from combblas_tpu.parallel import algebra as alg
 from combblas_tpu.parallel import distmat as dm
 from combblas_tpu.parallel import distvec as dv
@@ -102,6 +103,25 @@ make_col_stochastic = obs.instrument(
     make_col_stochastic, "mcl.make_col_stochastic", sync=True)
 
 
+@jax.jit
+def make_col_stochastic_block(bt):
+    """`make_col_stochastic` on a BlockTile (the output of a
+    block-planned expansion, e.g. `spgemm_phased(..., block_out=True)`):
+    identical reduce + dim_apply pipeline through the tile_algebra
+    format dispatch, staying in block form — no COO round-trip between
+    expansion and inflation. The column sums use blocktile.reduce's
+    canonical dense fold, so results are independent of the planner's
+    (bm, bn) choice and bit-identical to the COO path for every
+    order-insensitive monoid; float PLUS sums can differ from the COO
+    chunked-scan grouping in the last ulp (same structure, same nnz)."""
+    sums = talg.reduce(S.PLUS, bt, "col")
+    return talg.dim_apply(bt, "col", _inv_or_zero(sums), _times)
+
+
+make_col_stochastic_block = obs.instrument(
+    make_col_stochastic_block, "mcl.make_col_stochastic_block", sync=True)
+
+
 def _chaos_from(a: dm.DistSpMat):
     """Traced chaos expression, NaN-safe: an all-pruned (empty) column
     leaves colmax at the MAX identity (-inf) and colssq at 0 — the raw
@@ -163,6 +183,21 @@ def _pow(v, power):
 
 
 inflate = obs.instrument(inflate, "mcl.inflate", sync=True)
+
+
+@partial(jax.jit, static_argnames=("power",))
+def inflate_block(bt, power: float):
+    """`inflate` on a BlockTile: Hadamard power over stored entries +
+    block-form column re-normalization. With a block-planned expansion
+    this keeps the whole expansion→inflate leg of an MCL mega-step in
+    dense-block form; the conversion back to COO (if any) happens at
+    the caller's phase boundary via `blocktile.from_blocks`."""
+    powed = talg.apply(bt, partial(_pow, power=power))
+    return make_col_stochastic_block(powed)
+
+
+inflate_block = obs.instrument(inflate_block, "mcl.inflate_block",
+                               sync=True)
 
 
 def _repin_traced(a: dm.DistSpMat, new_cap: int) -> dm.DistSpMat:
@@ -315,7 +350,9 @@ def mcl(a: dm.DistSpMat, params: MclParams = MclParams(),
 #: (megastep = repin + inflate + stochastic + chaos fused)
 _MCL_COSTS = {
     "mcl.make_col_stochastic": (2.0, 24.0),
+    "mcl.make_col_stochastic_block": (2.0, 24.0),
     "mcl.inflate": (4.0, 24.0),
+    "mcl.inflate_block": (4.0, 24.0),
     "mcl.chaos_dev": (4.0, 12.0),
     "mcl.repin": (0.0, 24.0),
     "mcl.megastep": (8.0, 48.0),

@@ -1,0 +1,508 @@
+"""Block-sparse (BCSR / dense-block) tile format.
+
+The padded-COO `Tile` is the canonical interchange format, but on
+near-dense SpGEMM windows every intermediate still round-trips through
+COO sort/compact tails even when the accumulator itself was dense
+(PR-8's `dense_mxu` proved the MXU win and then paid the round trip
+anyway). This module adds the second local format the ROADMAP names —
+the JITSPMM direction (arxiv/2312.05639) over CombBLAS 2.0's semiring
+surface (arxiv/2106.14402):
+
+  * fixed ``(bm, bn)`` **dense value blocks** plus a block-index COO
+    (per-block row/col *starts*), with a **static block capacity** so
+    the whole structure is one jit/shard_map-stable pytree;
+  * **monoid-zero padding** inside blocks: cells not marked in the
+    ``touched`` plane carry ``add.identity`` so any reassociation-safe
+    reduction over a raw block is a no-op on padding — and a separate
+    0/1 ``touched`` plane (not a value comparison) preserves ESC's
+    explicit-zero structure exactly, mirroring `densify_operand`;
+  * **bit-exact converters** to/from `Tile`: `from_blocks` routes
+    through `tl.from_coo`, whose overflow contract (drop the largest
+    (row, col) coordinates) is the ESC sort-then-truncate order, and
+    `to_blocks` drops the largest *block* coordinates at block-capacity
+    saturation — the block-granular analogue, pinned by tests;
+  * a window SpGEMM (`spgemm_colwindow_block`) whose output *stays in
+    block form* — zero sorts, zero COO materialization; the planner
+    converts at phase boundaries only (see parallel/spgemm.py).
+
+Block invariants: blocks are (bm, bn)-aligned to the tile grid for
+converter outputs (window-kernel outputs are row-aligned, column-offset
+by the traced window base), sorted lexicographically by
+(rstart, cstart), pairwise disjoint; dead block slots carry the
+(nrows, ncols) start sentinel so they sort last, exactly like Tile
+padding. The kernel family in `ops/pallas_kernels.py`
+(`block_window_multiply`) is shape-specialized per (bm, bn, semiring)
+through jit static arguments, the same mechanism `PlanCache` uses to
+specialize executables per capacity bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.ops.semiring import Monoid, Semiring
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class BlockTile:
+    """Dense-block sparse tile with static block shape and capacity.
+
+    ``vals``/``touched`` are (bcap, bm, bn); the first ``nblk`` (traced)
+    block slots are live, sorted lexicographically by (rstart, cstart)
+    and pairwise disjoint; dead slots carry rstart==nrows,
+    cstart==ncols. Within a block, ``touched[i, r, c] > 0`` marks a
+    stored entry at global (rstart[i]+r, cstart[i]+c); untouched cells
+    hold the monoid zero of the add monoid the tile was built under.
+    """
+
+    rstart: Array        # (bcap,) int32 — first global row of block
+    cstart: Array        # (bcap,) int32 — first global col of block
+    vals: Array          # (bcap, bm, bn) dtype
+    touched: Array       # (bcap, bm, bn) int32 0/1
+    nblk: Array          # () int32 — live block count
+    nrows: int = dataclasses.field(metadata=dict(static=True))
+    ncols: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def bcap(self) -> int:
+        return self.vals.shape[0]
+
+    @property
+    def bm(self) -> int:
+        return self.vals.shape[1]
+
+    @property
+    def bn(self) -> int:
+        return self.vals.shape[2]
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def block_valid(self) -> Array:
+        return jnp.arange(self.bcap, dtype=jnp.int32) < self.nblk
+
+    def cell_valid(self) -> Array:
+        """(bcap, bm, bn) bool: stored-entry mask (live block, touched
+        cell, inside the tile bounds)."""
+        r, c = _cell_coords(self)
+        return ((self.touched > 0)
+                & self.block_valid()[:, None, None]
+                & (r < self.nrows) & (c < self.ncols))
+
+    def nnz(self) -> Array:
+        """Traced stored-entry count."""
+        return jnp.sum(self.cell_valid()).astype(jnp.int32)
+
+
+def _cell_coords(bt: BlockTile):
+    """Global (row, col) of every cell, (bcap, bm, bn) i32 each."""
+    shape = bt.vals.shape
+    r = (bt.rstart[:, None, None]
+         + lax.broadcasted_iota(jnp.int32, shape, 1))
+    c = (bt.cstart[:, None, None]
+         + lax.broadcasted_iota(jnp.int32, shape, 2))
+    return r, c
+
+
+def _grid(nrows: int, ncols: int, bm: int, bn: int):
+    """(block rows, block cols) of the aligned grid, with an i32 guard
+    on the block-id key space."""
+    nbr = -(-nrows // bm)
+    nbc = -(-ncols // bn)
+    if nbr * nbc + 1 > 2**31 - 1:
+        raise ValueError(
+            f"block grid {nbr}x{nbc} overflows the i32 block-id space; "
+            f"choose a larger block shape than ({bm}, {bn})")
+    return nbr, nbc
+
+
+def empty(nrows: int, ncols: int, *, bm: int, bn: int, bcap: int,
+          dtype=jnp.float32) -> BlockTile:
+    return BlockTile(
+        rstart=jnp.full((bcap,), nrows, jnp.int32),
+        cstart=jnp.full((bcap,), ncols, jnp.int32),
+        vals=jnp.zeros((bcap, bm, bn), dtype),
+        touched=jnp.zeros((bcap, bm, bn), jnp.int32),
+        nblk=jnp.zeros((), jnp.int32),
+        nrows=nrows, ncols=ncols)
+
+
+# ---------------------------------------------------------------------------
+# Converters — the bit-exactness boundary with the padded-COO Tile
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("add", "bm", "bn", "bcap"))
+def to_blocks(add: Monoid, t: tl.Tile, *, bm: int, bn: int,
+              bcap: int) -> BlockTile:
+    """Pack a sorted COO tile into (bm, bn)-aligned dense blocks.
+
+    Untouched cells are filled with ``add.identity`` (monoid-zero
+    padding); explicit stored zeros stay distinguishable through the
+    ``touched`` plane. Overflow contract at block-capacity saturation:
+    when the tile touches more than ``bcap`` distinct blocks, the
+    *largest* block ids — i.e. the largest (block-row, block-col)
+    coordinates, whole blocks at a time — are dropped, the
+    block-granular analogue of `from_coo`'s largest-coordinate drop.
+    """
+    nbr, nbc = _grid(t.nrows, t.ncols, bm, bn)
+    sent = nbr * nbc
+    v = t.valid()
+    bid = jnp.where(v, (t.rows // bm) * nbc + (t.cols // bn), sent)
+    ub = jnp.unique(bid, size=bcap, fill_value=sent)
+    slot = jnp.clip(jnp.searchsorted(ub, bid), 0, bcap - 1).astype(jnp.int32)
+    ok = v & (ub[slot] == bid)
+    n = bcap * bm * bn
+    fi = jnp.where(ok, slot * (bm * bn) + (t.rows % bm) * bn + (t.cols % bn),
+                   n)
+    zero = add.identity_scalar(t.dtype)
+    vals = jnp.full((n,), zero, t.dtype).at[fi].set(
+        t.vals, mode="drop").reshape(bcap, bm, bn)
+    touched = jnp.zeros((n,), jnp.int32).at[fi].set(
+        1, mode="drop").reshape(bcap, bm, bn)
+    live_b = ub < sent
+    rstart = jnp.where(live_b, (ub // nbc) * bm, t.nrows).astype(jnp.int32)
+    cstart = jnp.where(live_b, (ub % nbc) * bn, t.ncols).astype(jnp.int32)
+    nblk = jnp.sum(live_b).astype(jnp.int32)
+    return BlockTile(rstart, cstart, vals, touched, nblk,
+                     t.nrows, t.ncols)
+
+
+@partial(jax.jit, static_argnames=("add", "cap", "dedup"))
+def from_blocks(add: Monoid, bt: BlockTile, *, cap: int,
+                dedup: bool = False) -> tl.Tile:
+    """Unpack blocks into a sorted COO tile via `tl.from_coo`, so the
+    output-capacity overflow order (drop the largest (row, col)) is
+    identical to the ESC sort-then-truncate contract. Blocks are
+    disjoint by invariant, so ``dedup=False`` is the default; pass
+    ``dedup=True`` for untrusted block lists."""
+    r, c = _cell_coords(bt)
+    valid = bt.cell_valid()
+    return tl.from_coo(add, r.ravel(), c.ravel(), bt.vals.ravel(),
+                       nrows=bt.nrows, ncols=bt.ncols, cap=cap,
+                       valid=valid.ravel(), dedup=dedup)
+
+
+@jax.jit
+def flatten(bt: BlockTile):
+    """Sentinel-masked COO render of a block tile — the final-sort
+    merge format of the phased loops: (rows, cols, vals, nlive) with
+    dead cells at the (nrows, ncols) sentinel, vals zeroed at dead
+    cells (the Tile padding-value convention)."""
+    r, c = _cell_coords(bt)
+    valid = bt.cell_valid()
+    rows = jnp.where(valid, r, bt.nrows).ravel()
+    cols = jnp.where(valid, c, bt.ncols).ravel()
+    vals = jnp.where(valid, bt.vals,
+                     jnp.zeros((), bt.dtype)).ravel()
+    return rows, cols, vals, jnp.sum(valid).astype(jnp.int32)
+
+
+def concat_blocks(parts: list) -> BlockTile:
+    """Concatenate disjoint same-shape block tiles (e.g. per-window
+    outputs over disjoint column ranges) into one BlockTile, restoring
+    the (rstart, cstart) block sort order. Eager driver-level helper."""
+    p0 = parts[0]
+    if len(parts) == 1:
+        return p0
+    rstart = jnp.concatenate([p.rstart for p in parts])
+    cstart = jnp.concatenate([p.cstart for p in parts])
+    vals = jnp.concatenate([p.vals for p in parts])
+    touched = jnp.concatenate([p.touched for p in parts])
+    live = jnp.concatenate([p.block_valid() for p in parts])
+    rs = jnp.where(live, rstart, p0.nrows)
+    cs = jnp.where(live, cstart, p0.ncols)
+    order = jnp.lexsort((cs, rs))
+    nblk = jnp.sum(live).astype(jnp.int32)
+    return BlockTile(rs[order], cs[order], vals[order], touched[order],
+                     nblk, p0.nrows, p0.ncols)
+
+
+@partial(jax.jit, static_argnames=("zero",))
+def to_dense(bt: BlockTile, zero=0.0) -> Array:
+    """(nrows, ncols) dense render, absent cells at ``zero`` — the
+    canonical layout `reduce` folds over (plus the test/debug
+    surface)."""
+    r, c = _cell_coords(bt)
+    valid = bt.cell_valid()
+    n = bt.nrows * bt.ncols
+    fi = jnp.where(valid, r * bt.ncols + c, n).ravel()
+    out = jnp.full((n,), zero, bt.dtype)
+    return out.at[fi].set(jnp.where(valid, bt.vals, 0).ravel(),
+                          mode="drop").reshape(bt.nrows, bt.ncols)
+
+
+# ---------------------------------------------------------------------------
+# Block-level structural + EWise ops (the tile_algebra surface on blocks)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def transpose(bt: BlockTile) -> BlockTile:
+    """Swap block coordinates and transpose every block in place — no
+    element sort (the block list re-sorts by the swapped starts, a
+    bcap-length sort instead of a cap-length one)."""
+    live = bt.block_valid()
+    rs = jnp.where(live, bt.cstart, bt.ncols)
+    cs = jnp.where(live, bt.rstart, bt.nrows)
+    order = jnp.lexsort((cs, rs))
+    return BlockTile(rs[order], cs[order],
+                     bt.vals.transpose(0, 2, 1)[order],
+                     bt.touched.transpose(0, 2, 1)[order],
+                     bt.nblk, bt.ncols, bt.nrows)
+
+
+@partial(jax.jit, static_argnames=("fn",))
+def apply(bt: BlockTile, fn) -> BlockTile:
+    """EWise map over stored entries only (≅ alg.apply): untouched
+    cells keep their monoid-zero padding untouched, so the result is
+    bit-identical to the COO-path apply on the stored set."""
+    return dataclasses.replace(
+        bt, vals=jnp.where(bt.touched > 0, fn(bt.vals), bt.vals))
+
+
+@partial(jax.jit, static_argnames=("dim", "fn"))
+def dim_apply(bt: BlockTile, dim: str, vec: Array, fn) -> BlockTile:
+    """Scale stored entries by a per-row/col vector (≅ alg.dim_apply):
+    ``fn(vals, vec[row-or-col])`` on touched cells."""
+    r, c = _cell_coords(bt)
+    idx = c if dim == "col" else r
+    g = vec[jnp.clip(idx, 0, vec.shape[0] - 1)]
+    return dataclasses.replace(
+        bt, vals=jnp.where(bt.touched > 0, fn(bt.vals, g), bt.vals))
+
+
+@partial(jax.jit, static_argnames=("add",))
+def compact(bt: BlockTile, keep: Array, add: Monoid) -> BlockTile:
+    """Drop stored entries where ``keep`` (same shape as vals) is False,
+    reset dropped cells to the monoid zero, and compact fully-emptied
+    blocks out of the live prefix (stable block order, so sortedness is
+    preserved — the block analogue of alg.compact's stable argsort)."""
+    touched = jnp.where(keep, bt.touched, 0)
+    zero = jnp.asarray(add.identity_scalar(bt.dtype), bt.dtype)
+    vals = jnp.where(touched > 0, bt.vals, zero)
+    alive = (jnp.any(touched > 0, axis=(1, 2))) & bt.block_valid()
+    order = jnp.argsort(~alive, stable=True)
+    alive_s = alive[order]
+    rs = jnp.where(alive_s, bt.rstart[order], bt.nrows)
+    cs = jnp.where(alive_s, bt.cstart[order], bt.ncols)
+    return BlockTile(rs, cs, vals[order], touched[order],
+                     jnp.sum(alive).astype(jnp.int32), bt.nrows, bt.ncols)
+
+
+@partial(jax.jit, static_argnames=("add", "pred"))
+def prune_column(bt: BlockTile, thresh: Array, pred, add: Monoid
+                 ) -> BlockTile:
+    """Drop stored entries where ``pred(vals, thresh[col])`` holds
+    (≅ alg.prune_column on blocks — MCL's per-column prune surface)."""
+    _, c = _cell_coords(bt)
+    tv = thresh[jnp.clip(c, 0, thresh.shape[0] - 1)]
+    keep = (bt.touched > 0) & ~pred(bt.vals, tv)
+    return compact(bt, keep, add)
+
+
+@partial(jax.jit, static_argnames=("monoid", "axis"))
+def reduce(monoid: Monoid, bt: BlockTile, axis: str) -> Array:
+    """Per-column ("col") or per-row ("row") reduction over stored
+    entries; absent lines stay at the monoid identity.
+
+    Combine order is the CANONICAL dense fold over the logical
+    (nrows, ncols) plane — a function of the tile's logical shape
+    only, never of (bm, bn, bcap). The planner's per-window block
+    shape therefore cannot perturb downstream numerics, and every
+    order-insensitive monoid (integer add, min/max, bool or/and) is
+    bit-identical to the Tile path. Float PLUS sums may differ from
+    the COO chunked-scan grouping in the last ulp; `make_col_
+    stochastic_block`'s docstring carries the caveat."""
+    ident = monoid.identity_scalar(bt.dtype)
+    dense = to_dense(bt, zero=ident)
+    fold = {"add": jnp.sum, "min": jnp.min, "max": jnp.max,
+            "or": jnp.max, "and": jnp.min}[monoid.kind]
+    return fold(dense, axis=1 if axis == "row" else 0)
+
+
+# ---------------------------------------------------------------------------
+# Block window SpGEMM — the sort-free accumulator that STAYS in block form
+# ---------------------------------------------------------------------------
+
+def _window_grid(nrows: int, win_width: int, bm: int, bn: int):
+    nrb = -(-nrows // bm)
+    nwb = -(-win_width // bn)
+    return nrb, nwb
+
+
+def _pad_rows(plane: Array, m: int, fill):
+    """Pad the leading (row) dim of a 2-D plane up to ``m``."""
+    if plane.shape[0] == m:
+        return plane
+    pad = jnp.full((m - plane.shape[0], plane.shape[1]), fill, plane.dtype)
+    return jnp.concatenate([plane, pad], axis=0)
+
+
+def _densify_b_window(b: tl.Tile, clo, chi, W: int, carrier):
+    """(k, W) value + presence planes of B's column window — the
+    `_mxu_window` B render at an arbitrary carrier dtype."""
+    k = b.nrows
+    wcol = b.cols - clo
+    bok = b.valid() & (wcol >= 0) & (wcol < jnp.minimum(chi - clo, W))
+    fib = jnp.where(bok, b.rows * W + wcol, k * W)
+    bvals = jnp.zeros((k * W,), carrier).at[fib].set(
+        b.vals.astype(carrier), mode="drop").reshape(k, W)
+    bpres = jnp.zeros((k * W,), jnp.float32).at[fib].set(
+        1.0, mode="drop").reshape(k, W)
+    return bvals, bpres
+
+
+@partial(jax.jit, static_argnames=("sr", "flops_cap", "win_width", "bm",
+                                   "bn", "mxu", "pallas_mode"))
+def _spgemm_colwindow_block_impl(
+        sr: Semiring, a: tl.Tile, b: tl.Tile, clo: Array, chi: Array, *,
+        flops_cap: int, win_width: int, bm: int, bn: int,
+        mxu: bool = False, b_struct=None, a_dense=None,
+        pallas_mode: str = "off") -> BlockTile:
+    """`spgemm_colwindow` whose accumulator IS the output: a block-dense
+    (ceil(nrows/bm) x ceil(win_width/bn)) grid of (bm, bn) blocks over
+    rows x [clo, clo+win_width) — ZERO sorts, zero COO materialization
+    (`esc.block_window` pins it). Three bodies share the layout:
+
+      * ``mxu=True``: the PR-8 `_mxu_window` matmul pair (value +
+        presence), reshaped to blocks — exactly-representable monoids
+        only (the `dense_mxu` float rule applies);
+      * ``pallas_mode != "off"``: the shape-specialized Pallas family
+        (`pk.block_window_multiply`), one executable per
+        (bm, bn, semiring); the generic path combines k-lanes in
+        ascending order = the ESC expansion order, so it is bit-exact
+        even for float plus-times;
+      * default: the XLA fused-key scatter reference — the
+        `spgemm_colwindow_dense` body scattered straight into the
+        padded block layout (duplicates combine in expansion-sequence
+        order, bit-exact vs ESC always).
+
+    The caller sizes ``flops_cap`` >= the window's flops (the planner
+    guarantees it); output-capacity truncation happens at the phase
+    boundary (`from_blocks`/final sort), never here.
+    """
+    assert a.ncols == b.nrows, "inner dimension mismatch (DIMMISMATCH)"
+    tl._flops_cap_guard(flops_cap)
+    kind = sr.add.kind
+    if kind not in tl.ACCUM_KINDS:
+        raise ValueError(
+            f"block window accumulator needs a known monoid kind "
+            f"(one of {tl.ACCUM_KINDS}), got {sr.add.name!r} with "
+            f"kind={kind!r}; route user monoids to the ESC path")
+    nrows = a.nrows
+    nrb, nwb = _window_grid(nrows, win_width, bm, bn)
+    M, W = nrb * bm, nwb * bn
+    out_dtype = jax.eval_shape(
+        sr.multiply, jax.ShapeDtypeStruct((), a.dtype),
+        jax.ShapeDtypeStruct((), b.dtype)).dtype
+
+    if mxu and pallas_mode == "off":
+        if not tl.mxu_eligible(sr, a.dtype, b.dtype):
+            raise ValueError(
+                f"mxu=True needs a plus-times semiring over non-bool "
+                f"operands, got {sr.name!r} ({a.dtype} x {b.dtype})")
+        dense, touched = tl._mxu_window(sr, a, b, clo, chi, W, a_dense,
+                                        out_dtype)
+        dense = _pad_rows(dense.reshape(nrows, W), M,
+                          jnp.zeros((), out_dtype))
+        touched = _pad_rows(touched.reshape(nrows, W), M, 0)
+    elif pallas_mode != "off":
+        from combblas_tpu.ops import pallas_kernels as pk
+        is_bool = out_dtype == jnp.bool_
+        carrier = jnp.int32 if is_bool else out_dtype
+        if a_dense is None or is_bool:
+            a_dense = tl.densify_operand(a, dtype=carrier)
+        avals, apres = a_dense
+        bvals, bpres = _densify_b_window(b, clo, chi, W, carrier)
+        if is_bool:
+            mul = tl._widened_multiply(sr.multiply, a.dtype == jnp.bool_,
+                                       b.dtype == jnp.bool_)
+            cmb, ident = tl._widened_combine(sr.add, True)
+        else:
+            mul, cmb = sr.multiply, sr.add.combine
+            ident = sr.add.identity_scalar(carrier)
+        use_dot = mxu and tl.mxu_eligible(sr, a.dtype, b.dtype)
+        dense, touched = pk.block_window_multiply(
+            _pad_rows(avals.astype(carrier), M, ident),
+            _pad_rows(apres, M, 0.0), bvals, bpres,
+            bm=bm, bn=bn, multiply=mul, combine=cmb, ident_val=ident,
+            use_dot=use_dot, interpret=pallas_mode == "interpret")
+        if is_bool:
+            dense = dense > 0
+    else:
+        info = (tl.fused_key_info(nrows, b.ncols, width=win_width)
+                if tl.fused_keys_enabled() else None)
+        if info is None:
+            raise ValueError(
+                f"block window accumulator needs the window-relative "
+                f"fused-key codec (nrows={nrows}, win_width={win_width} "
+                f"found no key dtype, or COMBBLAS_TPU_FUSED_KEY=0); "
+                f"route to the ESC path")
+        stride, kdt = info
+        per, base = tl._window_counts(a, b, clo, chi, b_struct)
+        key, cval, total = tl._expand_keyed(sr, a, b, per, base, flops_cap,
+                                            stride=stride, kdt=kdt, clo=clo)
+        n = M * W
+        r = (key // stride).astype(jnp.int32)
+        w = (key % stride).astype(jnp.int32)
+        # scatter straight into the row-padded block layout: same update
+        # order as the dense variant, so combines are bit-exact vs ESC
+        fi = jnp.where((r < nrows) & (w < win_width), r * W + w, n)
+        if kind in ("or", "and"):
+            if out_dtype != jnp.bool_:
+                raise ValueError(
+                    f"or/and block accumulation expects bool products, "
+                    f"got {out_dtype}")
+            ident = int(bool(sr.add.identity_scalar(jnp.bool_)))
+            flat = jnp.full((n,), ident, jnp.int32)
+            flat = tl._monoid_scatter("max" if kind == "or" else "min",
+                                      flat, fi, cval.astype(jnp.int32))
+            flat = flat > 0
+        else:
+            flat = jnp.full((n,), sr.add.identity(out_dtype), out_dtype)
+            flat = tl._monoid_scatter(kind, flat, fi, cval)
+        touched = jnp.zeros((n,), jnp.int32).at[fi].max(
+            jnp.ones((flops_cap,), jnp.int32), mode="drop").reshape(M, W)
+        dense = flat.reshape(M, W)
+
+    bcap = nrb * nwb
+    vals = dense.reshape(nrb, bm, nwb, bn).transpose(0, 2, 1, 3).reshape(
+        bcap, bm, bn)
+    tch = touched.astype(jnp.int32).reshape(
+        nrb, bm, nwb, bn).transpose(0, 2, 1, 3).reshape(bcap, bm, bn)
+    ar = jnp.arange(bcap, dtype=jnp.int32)
+    rstart = (ar // nwb) * bm
+    cstart = jnp.asarray(clo, jnp.int32) + (ar % nwb) * bn
+    return BlockTile(rstart, cstart, vals, tch,
+                     jnp.asarray(bcap, jnp.int32), nrows, b.ncols)
+
+
+def spgemm_colwindow_block(sr: Semiring, a: tl.Tile, b: tl.Tile, clo, chi,
+                           *, flops_cap: int, win_width: int, bm: int,
+                           bn: int, mxu: bool = False, b_struct=None,
+                           a_dense=None) -> BlockTile:
+    """Dispatcher: resolves COMBBLAS_TPU_PALLAS_BLOCK OUTSIDE the jit
+    boundary (the PR-8 lesson / pass-7 env-in-trace rule) and forwards
+    a static ``pallas_mode`` so env flips remint rather than alias
+    executables."""
+    from combblas_tpu.ops import pallas_kernels as pk
+    if pk.block_enabled():
+        pallas_mode = "interpret" if pk.block_interpret() else "tpu"
+    else:
+        pallas_mode = "off"
+    return _spgemm_colwindow_block_impl(
+        sr, a, b, clo, chi, flops_cap=flops_cap, win_width=win_width,
+        bm=bm, bn=bn, mxu=mxu, b_struct=b_struct, a_dense=a_dense,
+        pallas_mode=pallas_mode)
+
+
+spgemm_colwindow_block._cache_size = _spgemm_colwindow_block_impl._cache_size

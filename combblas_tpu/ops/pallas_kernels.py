@@ -545,3 +545,148 @@ def hash_accumulate(key, val, *, table_cap: int, combine, ident_val,
         interpret=interpret,
     )(key.reshape(nb, _HASH_IB), val.reshape(nb, _HASH_IB))
     return tk.reshape(-1), tv.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Shape-specialized block window multiply — the BCSR SpGEMM accumulator
+# (ops/blocktile.py). One executable per (bm, bn, semiring) via jit
+# static args, the same per-bucket specialization PlanCache applies to
+# capacities. Layout: A^T planes (k, M) so the sequential k-lane walk of
+# the generic path extracts second-minor rows (cheap in Mosaic — no
+# minor-dim dynamic gather), B planes (k, W) natively row-extractable.
+# ---------------------------------------------------------------------------
+
+_BLOCK_KB = 128                # contraction depth per sequential grid step
+
+
+def block_mode() -> str:
+    # trace-time kernel selector; flips require jax.clear_caches()
+    return os.environ.get("COMBBLAS_TPU_PALLAS_BLOCK", "")  # analysis: allow(env-in-trace)
+
+
+def block_enabled() -> bool:
+    """Use the Pallas block-window kernel? Opt-IN on TPU backends (=1),
+    or anywhere under =interpret (tests); COMBBLAS_TPU_PALLAS=0 vetoes."""
+    mode = block_mode()
+    if mode == "interpret":
+        return os.environ.get("COMBBLAS_TPU_PALLAS", "") != "0"  # analysis: allow(env-in-trace) same clear_caches contract
+    return mode == "1" and enabled()
+
+
+def block_interpret() -> bool:
+    return block_mode() == "interpret"
+
+
+def _block_window_kernel(av_ref, ap_ref, bv_ref, bp_ref, cv_out, ct_out,
+                         acc_ref, cnt_ref, *, multiply, combine, ident_val,
+                         use_dot, nkb):
+    import jax.experimental.pallas as pl
+    from jax import lax
+
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.full(acc_ref.shape, ident_val, acc_ref.dtype)
+        cnt_ref[...] = jnp.zeros(cnt_ref.shape, cnt_ref.dtype)
+
+    av = av_ref[...]            # (KB, bm) — A^T slab
+    ap = ap_ref[...]            # (KB, bm) f32 presence
+    bv = bv_ref[...]            # (KB, bn)
+    bp = bp_ref[...]            # (KB, bn) f32 presence
+
+    if use_dot:
+        # exactly-representable monoids: one MXU pass per slab (value
+        # matmul + presence matmul, the PR-8 dense_mxu structure)
+        acc_ref[...] = acc_ref[...] + lax.dot_general(
+            av, bv, (((0,), (0,)), ((), ())),
+            preferred_element_type=acc_ref.dtype)
+        cnt_ref[...] = cnt_ref[...] + lax.dot_general(
+            ap, bp, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        # generic semiring: combine k-lanes in ASCENDING order — the
+        # ESC expansion-sequence order — so even float plus-times is
+        # bit-exact vs the reference
+        def lane(j, carry):
+            acc, cnt = carry
+            pa = jnp.transpose(lax.dynamic_slice_in_dim(av, j, 1, 0))
+            qa = jnp.transpose(lax.dynamic_slice_in_dim(ap, j, 1, 0))
+            pb = lax.dynamic_slice_in_dim(bv, j, 1, 0)
+            qb = lax.dynamic_slice_in_dim(bp, j, 1, 0)
+            present = (qa > 0) & (qb > 0)          # (bm, 1) & (1, bn)
+            prod = jnp.where(present, multiply(pa, pb),
+                             jnp.asarray(ident_val, acc.dtype))
+            return combine(acc, prod), cnt + present.astype(jnp.float32)
+
+        acc, cnt = lax.fori_loop(0, av.shape[0], lane,
+                                 (acc_ref[...], cnt_ref[...]))
+        acc_ref[...] = acc
+        cnt_ref[...] = cnt
+
+    @pl.when(k == nkb - 1)
+    def _emit():
+        cv_out[...] = acc_ref[...]
+        ct_out[...] = (cnt_ref[...] > 0.5).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "multiply",
+                                             "combine", "ident_val",
+                                             "use_dot", "interpret"))
+def block_window_multiply(avals, apres, bvals, bpres, *, bm: int, bn: int,
+                          multiply, combine, ident_val, use_dot: bool,
+                          interpret: bool = False):
+    """Semiring multiply of densified planes into (bm, bn) output blocks.
+
+    ``avals``/``apres``: (M, k) value + 0/1 f32 presence planes of A
+    (M a multiple of bm); ``bvals``/``bpres``: (k, W) planes of the B
+    column window (W a multiple of bn). Returns (cvals, ctouched),
+    both (M, W), ctouched int32 0/1 — exactly the `_mxu_window`
+    contract, blockwise. ``multiply``/``combine``/``ident_val`` must be
+    cache-stable statics (bool data pre-widened to int32 carriers by
+    the caller — Mosaic has no i1/i8 vector compute). ``use_dot``
+    rides the MXU (plus-times only; floats under the dense_mxu
+    exactness rule); otherwise k-lanes combine sequentially in
+    ascending order, matching ESC's expansion-sequence combine order
+    bit-exactly. Presence counts ride f32 (exact below 2^24
+    products/cell — the `_mxu_window` caveat)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    M, k = avals.shape
+    kb, W = bvals.shape
+    assert k == kb, "inner dimension mismatch"
+    assert M % bm == 0 and W % bn == 0, "planes must be block-padded"
+    nrb, nwb = M // bm, W // bn
+    nkb = max(1, -(-k // _BLOCK_KB))
+    padK = nkb * _BLOCK_KB
+    if padK != k:
+        zpad = ((0, 0), (0, padK - k))
+        avals = jnp.pad(avals, zpad)
+        apres = jnp.pad(apres, zpad)
+        kpad = ((0, padK - k), (0, 0))
+        bvals = jnp.pad(bvals, kpad)
+        bpres = jnp.pad(bpres, kpad)
+    avT, apT = avals.T, apres.T             # (padK, M)
+
+    kernel = functools.partial(_block_window_kernel, multiply=multiply,
+                               combine=combine, ident_val=ident_val,
+                               use_dot=use_dot, nkb=nkb)
+    aspec = pl.BlockSpec((_BLOCK_KB, bm), lambda i, j, q: (q, i),
+                         memory_space=pltpu.VMEM)
+    bspec = pl.BlockSpec((_BLOCK_KB, bn), lambda i, j, q: (q, j),
+                         memory_space=pltpu.VMEM)
+    ospec = pl.BlockSpec((bm, bn), lambda i, j, q: (i, j),
+                         memory_space=pltpu.VMEM)
+    cv, ct = pl.pallas_call(
+        kernel,
+        grid=(nrb, nwb, nkb),
+        in_specs=[aspec, aspec, bspec, bspec],
+        out_specs=[ospec, ospec],
+        out_shape=[_sds((M, W), avals.dtype, avals),
+                   _sds((M, W), jnp.int32, avals)],
+        scratch_shapes=[pltpu.VMEM((bm, bn), avals.dtype),
+                        pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(avT, apT, bvals, bpres)
+    return cv, ct

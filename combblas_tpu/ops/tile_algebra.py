@@ -31,6 +31,15 @@ from combblas_tpu.ops.tile import Tile
 Array = jax.Array
 
 
+def _as_blocktile(t):
+    """The BlockTile instance when ``t`` is one, else None — the
+    format dispatch of the reduce/apply/prune surface, so MCL-style
+    pipelines run unchanged on either format (see ops.blocktile for
+    each block body's combine-order contract)."""
+    from combblas_tpu.ops import blocktile as bk
+    return t if isinstance(t, bk.BlockTile) else None
+
+
 # ---------------------------------------------------------------------------
 # Keep-mask compaction (the shared body of the prune/EWise family)
 # ---------------------------------------------------------------------------
@@ -98,7 +107,13 @@ def reduce_cols(monoid: Monoid, t: Tile, map_val: Callable = None) -> Array:
 def reduce(monoid: Monoid, t: Tile, dim: str,
            map_val: Callable = None) -> Array:
     """dim="row": out[i] over row i (length nrows); dim="col": out[j]
-    over column j (length ncols)."""
+    over column j (length ncols). Accepts a BlockTile (canonical
+    dense-fold combine order — see ops.blocktile.reduce)."""
+    if (bt := _as_blocktile(t)) is not None:
+        from combblas_tpu.ops import blocktile as bk
+        if map_val is not None:
+            bt = bk.apply(bt, map_val)
+        return bk.reduce(monoid, bt, dim)
     if dim == "row":
         return reduce_rows(monoid, t, map_val)
     if dim == "col":
@@ -107,8 +122,12 @@ def reduce(monoid: Monoid, t: Tile, dim: str,
 
 
 def apply(t: Tile, fn: Callable[[Array], Array]) -> Tile:
-    """Elementwise value transform on live entries (≅ SpParMat::Apply)."""
+    """Elementwise value transform on live entries (≅ SpParMat::Apply).
+    Accepts a BlockTile (stored entries only; padding stays put)."""
     import dataclasses
+    if _as_blocktile(t) is not None:
+        from combblas_tpu.ops import blocktile as bk
+        return bk.apply(t, fn)
     vals = jnp.where(t.valid(), fn(t.vals), t.vals)
     return dataclasses.replace(t, vals=vals)
 
@@ -135,10 +154,17 @@ def prune_i(t: Tile, pred: Callable[[Array, Array, Array], Array],
 
 def prune_column(t: Tile, thresh: Array,
                  pred: Callable[[Array, Array], Array],
-                 cap: Optional[int] = None) -> Tile:
+                 cap: Optional[int] = None,
+                 add: Optional[Monoid] = None) -> Tile:
     """Per-column pruning: drop entry (i,j,v) iff pred(v, thresh[j])
     (≅ PruneColumn, SpParMat.h:190 / dcsc.h:96). ``thresh`` is a dense
-    (ncols,) vector."""
+    (ncols,) vector. Accepts a BlockTile; ``add`` names the monoid
+    whose zero refills dropped cells there (default PLUS — MCL's)."""
+    if _as_blocktile(t) is not None:
+        from combblas_tpu.ops import blocktile as bk
+        from combblas_tpu.ops.semiring import PLUS
+        return bk.prune_column(t, thresh, pred, add if add is not None
+                               else PLUS)
     cg = jnp.clip(t.cols, 0, t.ncols - 1)
     keep = t.valid() & ~pred(t.vals, thresh[cg])
     return compact(t, keep, cap)
@@ -148,8 +174,11 @@ def dim_apply(t: Tile, dim: str, vec: Array,
               fn: Callable[[Array, Array], Array]) -> Tile:
     """v_ij <- fn(v_ij, vec[i]) (dim="row") or fn(v_ij, vec[j])
     (dim="col") (≅ DimApply, SpParMat.h:108 — e.g. column scaling for
-    MakeColStochastic, MCL.cpp:390)."""
+    MakeColStochastic, MCL.cpp:390). Accepts a BlockTile."""
     import dataclasses
+    if _as_blocktile(t) is not None:
+        from combblas_tpu.ops import blocktile as bk
+        return bk.dim_apply(t, dim, vec, fn)
     if dim == "row":
         g = vec[jnp.clip(t.rows, 0, t.nrows - 1)]
     elif dim == "col":

@@ -52,6 +52,7 @@ from jax.sharding import PartitionSpec as P
 
 from combblas_tpu import obs
 from combblas_tpu.obs import metrics as obm
+from combblas_tpu.ops import blocktile as bk
 from combblas_tpu.ops import pallas_kernels as pk
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops import tile_algebra as ta
@@ -101,6 +102,13 @@ _M_STUCK_FALLBACK = obm.counter(
 _M_BCAST = obm.counter("spgemm.bcast",
                        "SUMMA tile broadcasts per exchange variant "
                        "(kind=dense|sparse)")
+_M_FMT = obm.counter("spgemm.fmt",
+                     "windows dispatched per tile format "
+                     "(kind=coo|block)")
+_M_BLOCK_REJECT = obm.counter(
+    "spgemm.block_reject",
+    "windows demoted from block to coo format and why "
+    "(kind=mem at plan time; kind=semiring|hook|codec|buf at resolve)")
 
 
 def _check_product(a: DistSpMat, b: DistSpMat):
@@ -670,13 +678,100 @@ def mxu_float_enabled() -> bool:
         not in ("0", "", "false")
 
 
+def block_format_mode() -> str:
+    """COMBBLAS_TPU_BLOCK_FORMAT = coo (default) | block | auto.
+    Per-window tile-format selector: ``coo`` keeps every window on the
+    padded-COO accumulators, ``block`` forces the BCSR block format on
+    every window it is eligible for, ``auto`` chooses block when the
+    predicted window density clears `COMBBLAS_TPU_BLOCK_THRESHOLD`.
+    Resolved ONCE per plan (recorded on the `WinPlan` rows), never
+    inside a kernel."""
+    v = os.environ.get("COMBBLAS_TPU_BLOCK_FORMAT", "coo").lower()
+    if v not in ("coo", "block", "auto"):
+        raise ValueError(
+            f"COMBBLAS_TPU_BLOCK_FORMAT={v!r}: expected one of "
+            "coo|block|auto")
+    return v
+
+
+def block_shape() -> tuple[int, int]:
+    """COMBBLAS_TPU_BLOCK_SHAPE = "BMxBN" (default 8x128): the dense
+    block shape of planned block windows. BM a multiple of 8 and BN a
+    multiple of 128 keep blocks on the native (8, 128) f32/i32 vreg
+    tiling (see /opt/skills/guides — Mosaic pads anything smaller)."""
+    raw = os.environ.get("COMBBLAS_TPU_BLOCK_SHAPE", "8x128").lower()
+    try:
+        bm_s, bn_s = raw.split("x")
+        bm, bn = int(bm_s), int(bn_s)
+    except ValueError:
+        raise ValueError(
+            f"COMBBLAS_TPU_BLOCK_SHAPE={raw!r}: expected 'BMxBN', "
+            "e.g. 8x128") from None
+    if bm <= 0 or bn <= 0 or bm % 8 or bn % 128:
+        raise ValueError(
+            f"COMBBLAS_TPU_BLOCK_SHAPE={raw!r}: BM must be a positive "
+            "multiple of 8 and BN a positive multiple of 128 (the "
+            "native vreg tiling)")
+    return bm, bn
+
+
+def block_threshold() -> float:
+    """Density cutoff for ``auto`` block-format planning
+    (COMBBLAS_TPU_BLOCK_THRESHOLD, default 0.25 — the dense-variant
+    regime, where the block accumulator's padded planes are mostly
+    live and skipping the COO round-trip pays)."""
+    return _env_num("COMBBLAS_TPU_BLOCK_THRESHOLD", 0.25)
+
+
+def _block_temp_bytes(nrows: int, width: int, bm: int, bn: int,
+                      itemsize: int = 4) -> int:
+    """Compiled temp-byte estimate of one block window: the padded
+    value + touched output planes plus the densified B window (value +
+    presence) — the buffers the block kernels actually allocate."""
+    m = -(-nrows // bm) * bm
+    w = -(-width // bn) * bn
+    return m * w * (itemsize + 4) + nrows * w * (itemsize + 4)
+
+
+def _block_plan_ok(nrows: int, width: int, bm: int, bn: int) -> bool:
+    """PR-11 memory-ledger gate on the fmt decision: a block shape
+    whose predicted compiled temp bytes would blow the device headroom
+    budget (hbm x headroom_frac, when the ledger knows the device) is
+    rejected AT PLAN TIME — the window stays on the COO path instead of
+    OOMing at dispatch. Measured block-kernel footprints only LOOSEN
+    the gate (a plan no bigger than one that already dispatched is
+    never rejected): a past small run is evidence, not a ceiling."""
+    need = _block_temp_bytes(nrows, width, bm, bn)
+    try:
+        hr = obs.memledger.headroom()
+        hbm = float(hr.get("hbm_bytes") or 0.0)
+        frac = hr.get("headroom_frac")
+        ceil_ = (int(hbm * float(frac))
+                 if hbm > 0 and frac is not None else None)
+    except Exception:
+        ceil_ = None
+    if ceil_ is None:
+        return True
+    try:
+        for nm in ("spgemm.block/mxu", "spgemm.block/xla",
+                   "spgemm.block/pallas"):
+            fp = obs.memledger.footprint_for(nm)
+            if fp and fp.get("temp_bytes"):
+                ceil_ = max(ceil_, int(fp["temp_bytes"]))
+    except Exception:
+        pass
+    return need <= ceil_
+
+
 @dataclasses.dataclass(frozen=True)
 class WinPlan:
     """One column window of a phased-SpGEMM plan. Iterates/indexes as
     the legacy (clo, chi, flops_cap, out_cap) 4-tuple so existing
     consumers (scripts/spgemm_stream.py, tests) keep unpacking it;
-    the planner's density estimate and chosen local-kernel variant
-    ride as named fields."""
+    the planner's density estimate, chosen local-kernel variant, tile
+    format, and the env knobs that drove those choices (mode and
+    thresholds, resolved ONCE per plan — the satellite-1 retrace fix)
+    ride as named fields, so a plan is self-describing in /varz."""
     lo: int
     hi: int
     flops_cap: int
@@ -684,6 +779,13 @@ class WinPlan:
     flops: int = 0
     density: float = 0.0
     variant: str = "esc"
+    fmt: str = "coo"
+    mode: str = "auto"
+    dense_thr: float = 0.25
+    hash_thr: float = 1.0 / 16.0
+    block_thr: float = 0.25
+    bm: int = 8
+    bn: int = 128
 
     def __iter__(self):
         return iter((self.lo, self.hi, self.flops_cap, self.out_cap))
@@ -782,8 +884,14 @@ def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
     pairs = [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:])
              if hi > lo]
     pairs = _split_hubs(pairs, cum, hub_split_factor())
+    # satellite-1: EVERY env knob the per-window choices depend on is
+    # resolved here, once per plan, and recorded on the rows — the
+    # resolver and the loops read the rows, never the environment
     mode = local_variant_mode()
     dense_thr, hash_thr = variant_thresholds()
+    bfmt = block_format_mode()
+    bm, bn = block_shape()
+    block_thr = block_threshold()
     windows = []
     for lo, hi in pairs:
         f = int(cum[hi - 1] - (cum[lo - 1] if lo else 0))
@@ -797,10 +905,18 @@ def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
         # only the rounded-up bucket can cross the guard
         fit = cap_ladder.fit if cap_ladder is not None else _bucket_fine
         density = f / float(max(a.tile_m * (hi - lo), 1))
+        fmt = "coo"
+        if bfmt != "coo" and (bfmt == "block" or density >= block_thr):
+            if _block_plan_ok(a.tile_m, hi - lo, bm, bn):
+                fmt = "block"
+            else:
+                _M_BLOCK_REJECT.inc(kind="mem")
         windows.append(WinPlan(
             lo, hi, min(fit(max(f, 1), cap_round), _SAT),
             min(fit(oc, cap_round), _SAT), flops=f, density=density,
-            variant=_propose_variant(density, mode, dense_thr, hash_thr)))
+            variant=_propose_variant(density, mode, dense_thr, hash_thr),
+            fmt=fmt, mode=mode, dense_thr=dense_thr, hash_thr=hash_thr,
+            block_thr=block_thr, bm=bm, bn=bn))
     return windows
 
 
@@ -972,9 +1088,46 @@ obs.memledger.declare_donation("spgemm.grow3", (0, 1, 2),
                                waiver=_CAP_MOVE_WAIVER)
 
 
+#: block-format window kernels: the MXU matmul sub-variant for
+#: exactly-representable monoids, the XLA scatter reference (default),
+#: and the shape-specialized Pallas family (COMBBLAS_TPU_PALLAS_BLOCK)
+BLOCK_VARIANTS = ("block_mxu", "block_xla", "block_pallas")
+
+
 def _ledger_name(variant: str) -> str:
+    if variant in BLOCK_VARIANTS:
+        return f"spgemm.block/{variant[len('block_'):]}"
     return ("spgemm.colwindow" if variant == "esc"
             else f"spgemm.colwindow/{variant}")
+
+
+def _block_entry(variant: str):
+    """Block-window kernel entry: pins the kernel family per ledger
+    name (mxu/pallas/xla) and forwards `_cache_size` like
+    `_variant_entry`. Returns a BlockTile, not a Tile — the loops
+    stash block outputs and merge them at the phase boundary."""
+    mxu = variant == "block_mxu"
+
+    if variant == "block_pallas":
+        # env resolved OUTSIDE jit by the dispatcher (the PR-8 lesson)
+        def g(sr, at, bt, clo, chi, *, flops_cap, out_cap, win_width,
+              b_struct=None, a_dense=None, bm=8, bn=128):
+            return bk.spgemm_colwindow_block(
+                sr, at, bt, clo, chi, flops_cap=flops_cap,
+                win_width=win_width, bm=bm, bn=bn, mxu=False,
+                b_struct=b_struct, a_dense=a_dense)
+    else:
+        # pin pallas OFF statically: these ledger names must never
+        # alias the Pallas executable even when the env flag is set
+        def g(sr, at, bt, clo, chi, *, flops_cap, out_cap, win_width,
+              b_struct=None, a_dense=None, bm=8, bn=128):
+            return bk._spgemm_colwindow_block_impl(
+                sr, at, bt, clo, chi, flops_cap=flops_cap,
+                win_width=win_width, bm=bm, bn=bn, mxu=mxu,
+                b_struct=b_struct, a_dense=a_dense, pallas_mode="off")
+    g._cache_size = bk.spgemm_colwindow_block._cache_size
+    g.__name__ = f"colwindow_{variant}"
+    return g
 
 
 def _mk_kernel_table(sync: bool) -> dict:
@@ -990,6 +1143,9 @@ def _mk_kernel_table(sync: bool) -> dict:
             entry = _variant_entry(tl.spgemm_colwindow_dense,
                                    tl.spgemm_colwindow_dense, v)
         table[v] = obs.instrument(entry, _ledger_name(v), sync=sync)
+    for v in BLOCK_VARIANTS:
+        table[v] = obs.instrument(_block_entry(v), _ledger_name(v),
+                                  sync=sync)
     return table
 
 
@@ -1002,14 +1158,21 @@ _colwindow_async = _LOCAL_ASYNC["esc"]
 _colwindow_hooked = _HOOKED["esc"]
 _sort_compress = obs.instrument(tl.sort_compress, "spgemm.sort_compress",
                                 sync=True)
+# phase-boundary block->COO render (sentinel-masked arrays for the
+# final sort); async like the accumulator helpers — the sort drains it
+_block_flatten = obs.instrument(bk.flatten, "spgemm.block_flatten")
 
 
 def _resolve_variants(sr: Semiring, windows: list, win_width: int,
-                      at: tl.Tile, bt: tl.Tile) -> list[str]:
+                      at: tl.Tile, bt: tl.Tile,
+                      have_hook: bool = False) -> list[str]:
     """Final per-window variant choice: the planner proposed by density
     alone; here semiring/codec/memory eligibility downgrades to ESC and
     plus-times dense windows upgrade to the MXU sub-variant. ESC is
-    always safe — every downgrade lands there."""
+    always safe — every downgrade lands there. Windows the planner
+    marked ``fmt="block"`` resolve to a block kernel family
+    (mxu > pallas-if-enabled > xla scatter reference) or demote to the
+    coo proposal when the semiring/codec/hook disqualifies them."""
     out_dtype = jax.eval_shape(
         sr.multiply, jax.ShapeDtypeStruct((), at.dtype),
         jax.ShapeDtypeStruct((), bt.dtype)).dtype
@@ -1018,9 +1181,8 @@ def _resolve_variants(sr: Semiring, windows: list, win_width: int,
             if tl.fused_keys_enabled() else None)
     dmax = _dense_max()
     buf_ok = at.nrows * win_width <= dmax
-    dense_ok = (kind_ok and info is not None and buf_ok
-                and not (sr.add.kind in ("or", "and")
-                         and out_dtype != jnp.bool_))
+    bool_bad = sr.add.kind in ("or", "and") and out_dtype != jnp.bool_
+    dense_ok = kind_ok and info is not None and buf_ok and not bool_bad
     # the hash Pallas table is bounded; its XLA fallback allocates the
     # dense key space nrows*(win_width+1), so it obeys the same bound
     hash_ok = (kind_ok and info is not None and info[1] == jnp.int32
@@ -1030,10 +1192,33 @@ def _resolve_variants(sr: Semiring, windows: list, win_width: int,
               and at.nrows * at.ncols <= _mxu_amax()
               and (not jnp.issubdtype(out_dtype, jnp.floating)
                    or mxu_float_enabled()))
-    mode = local_variant_mode()
+    # satellite-1 fix: the mode was resolved ONCE in plan_colwindows
+    # and recorded on the rows; the old per-call env re-read here could
+    # disagree with the plan's read and mint a retraced variant set
+    mode = next((w.mode for w in windows if isinstance(w, WinPlan)),
+                None) or local_variant_mode()
+    use_pallas = pk.block_enabled()      # plan-scope read, outside jit
     out = []
     for w in windows:
         v = getattr(w, "variant", "esc")
+        if getattr(w, "fmt", "coo") == "block":
+            bm_, bn_ = getattr(w, "bm", 8), getattr(w, "bn", 128)
+            pad_m = -(-at.nrows // bm_) * bm_
+            pad_w = -(-win_width // bn_) * bn_
+            if have_hook:
+                # the prune hook's column-select surface is COO-typed;
+                # block-form hooks are a ROADMAP follow-up
+                _M_BLOCK_REJECT.inc(kind="hook")
+            elif not kind_ok or bool_bad:
+                _M_BLOCK_REJECT.inc(kind="semiring")
+            elif info is None:
+                _M_BLOCK_REJECT.inc(kind="codec")
+            elif pad_m * pad_w > dmax:
+                _M_BLOCK_REJECT.inc(kind="buf")
+            else:
+                out.append("block_mxu" if mxu_ok else
+                           "block_pallas" if use_pallas else "block_xla")
+                continue
         if v == "dense":
             if mxu_ok:
                 v = "dense_mxu"
@@ -1058,6 +1243,9 @@ def _annotate_window_costs(windows, variants, at, win_width) -> None:
       hash       expand + probe table of out_cap slots
       dense      expand + dense accumulator nrows*width
       dense_mxu  a REAL dense matmul: 2*nrows*ncols*width flops
+      block_mxu / block_pallas   the dense_mxu matmul pair plus the
+                 block value+touched planes (no COO compaction tail)
+      block_xla  the dense-variant scatter into the block layout
 
     The accumulator helpers (place/shrink/grow) stream ~2 slot-buffers
     per call; the nnz readbacks are 4-byte scalars. Everything the
@@ -1067,7 +1255,15 @@ def _annotate_window_costs(windows, variants, at, win_width) -> None:
         f = max(int(w.flops), 1)
         oc = int(w.out_cap)
         total_oc += oc
-        if v == "dense_mxu":
+        if v in ("block_mxu", "block_pallas"):
+            flops = 2.0 * at.nrows * at.ncols * win_width
+            lbytes = 4.0 * (at.nrows * at.ncols
+                            + 2 * at.nrows * win_width) \
+                + 8.0 * at.nrows * win_width
+        elif v == "block_xla":
+            flops = 2.0 * f
+            lbytes = _SLOT_B * f + 8.0 * at.nrows * win_width
+        elif v == "dense_mxu":
             flops = 2.0 * at.nrows * at.ncols * win_width
             lbytes = 4.0 * (at.nrows * at.ncols
                             + 2 * at.nrows * win_width) + _SLOT_B * f
@@ -1089,6 +1285,10 @@ def _annotate_window_costs(windows, variants, at, win_width) -> None:
         obs.costmodel.annotate("spgemm.sort_compress",
                                flops=2.0 * total_oc,
                                lbytes=4.0 * _SLOT_B * total_oc)
+        if any(v in BLOCK_VARIANTS for v in variants):
+            # phase-boundary block->COO render feeding the final sort
+            obs.costmodel.annotate("spgemm.block_flatten",
+                                   lbytes=2.0 * _SLOT_B * total_oc)
         for rb in ("spgemm.nnz_readback", "spgemm.nnz_deferred",
                    "spgemm.colwindow_nnz_readback"):
             obs.costmodel.annotate(rb, lbytes=4.0)
@@ -1122,7 +1322,8 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                 phases: Optional[int], phase_flop_budget: int,
                 prune_hook, out_cap: Optional[int],
                 cap_round: int,
-                cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
+                cap_ladder: Optional[CapLadder] = None,
+                block_out: bool = False):
     """OOM graceful-degradation shell around the phased window loop:
     a RESOURCE_EXHAUSTED failure (real allocator, or injected by
     `resilience.faults`) re-plans the multiply at a reduced
@@ -1141,7 +1342,8 @@ def _phased_1x1(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                                    phase_flop_budget=budget,
                                    prune_hook=prune_hook,
                                    out_cap=out_cap, cap_round=cap_round,
-                                   cap_ladder=cap_ladder)
+                                   cap_ladder=cap_ladder,
+                                   block_out=block_out)
         except Exception as e:      # noqa: BLE001 - classified below
             if not _faults.is_oom_error(e) or budget <= _OOM_BUDGET_FLOOR:
                 raise
@@ -1154,7 +1356,8 @@ def _phased_1x1_run(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                     phases: Optional[int], phase_flop_budget: int,
                     prune_hook, out_cap: Optional[int],
                     cap_round: int,
-                    cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
+                    cap_ladder: Optional[CapLadder] = None,
+                    block_out: bool = False):
     """Single-tile phased SpGEMM: plan once on host (ONE fetch of each
     operand's structure), then run every phase through one compiled
     dynamic-window kernel (`tile.spgemm_colwindow`). No per-phase host
@@ -1218,18 +1421,30 @@ def _phased_1x1_run(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         # density-adaptive local kernels: the planner proposed by
         # density, the resolver applies semiring/codec/memory
         # eligibility (always landing on ESC when in doubt)
-        variants = _resolve_variants(sr, windows, win_width, at, bt)
+        variants = _resolve_variants(sr, windows, win_width, at, bt,
+                                     have_hook=prune_hook is not None)
+        if block_out and not (variants
+                              and all(v in BLOCK_VARIANTS
+                                      for v in variants)):
+            raise ValueError(
+                "block_out=True requires every window planned AND "
+                "resolved in block format (COMBBLAS_TPU_BLOCK_FORMAT="
+                f"block, an accumulating semiring, no prune hook); "
+                f"got variants={variants}")
         a_dense = None
-        if any(v == "dense_mxu" for v in variants):
+        out_dtype = jax.eval_shape(
+            sr.multiply, jax.ShapeDtypeStruct((), at.dtype),
+            jax.ShapeDtypeStruct((), bt.dtype)).dtype
+        if any(v == "dense_mxu" or v == "block_mxu" for v in variants) \
+                or (out_dtype != jnp.bool_
+                    and any(v == "block_pallas" for v in variants)):
             # ONE window-independent A densification feeds every MXU
             # window of the plan (and, through the jit cache, every
             # iteration of an iterated pipeline)
-            out_dtype = jax.eval_shape(
-                sr.multiply, jax.ShapeDtypeStruct((), at.dtype),
-                jax.ShapeDtypeStruct((), bt.dtype)).dtype
             a_dense = tl.densify_operand(at, dtype=out_dtype)
         for w, v in zip(windows, variants):
             _M_VARIANT.inc(kind=v)
+            _M_FMT.inc(kind="block" if v in BLOCK_VARIANTS else "coo")
             _M_DENSITY.observe(w.density)
         _annotate_window_costs(windows, variants, at, win_width)
         # OOM-risk check against the peaks table's hbm_bytes (not the
@@ -1252,26 +1467,52 @@ def _phased_1x1_run(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
     if sync_windows_enabled():
         return _windows_sync(sr, a, b, at, bt, windows, win_width,
                              b_struct, prune_hook, out_cap, cap_round,
-                             fit, wrap, variants, a_dense)
+                             fit, wrap, variants, a_dense, block_out)
     return _windows_async(sr, a, b, at, bt, windows, win_width,
                           b_struct, prune_hook, out_cap, cap_round,
-                          fit, wrap, variants, a_dense)
+                          fit, wrap, variants, a_dense, block_out)
 
 
 def _windows_sync(sr, a, b, at, bt, windows, win_width, b_struct,
                   prune_hook, out_cap, cap_round, fit, wrap,
-                  variants=None, a_dense=None):
+                  variants=None, a_dense=None, block_out=False):
     """The r05 blocking reference loop (COMBBLAS_TPU_SYNC_WINDOWS=1):
     per-window device barriers, blocking nnz readbacks, host-known
     placement offsets. Kept verbatim as the async pipeline's
     bit-exactness oracle (the local kernel is variant-dispatched in
-    BOTH loops, so each variant is its own oracle pair)."""
+    BOTH loops, so each variant is its own oracle pair).
+
+    Block-format windows (variant in BLOCK_VARIANTS) skip the
+    shrink/place machinery entirely — their output is a dense-block
+    BlockTile stashed in `block_parts`, merged with the COO
+    accumulator only at the final sort (the phase boundary), or
+    returned as one concatenated BlockTile when ``block_out``."""
     if variants is None:
         variants = ["esc"] * len(windows)
     acc = None          # (rows, cols, vals) sentinel-padded, unsorted
     nlive = 0           # host-known live prefix of acc
+    block_parts = []    # BlockTile per block window (disjoint columns)
+    blk_ub = 0          # host UPPER BOUND on block-part nnz (for caps)
     for wi, (lo, hi, fc, oc) in enumerate(windows):
         v = variants[wi]
+        if v in BLOCK_VARIANTS:
+            with obs.span("window", w=wi, lo=lo, hi=hi, flops_cap=fc,
+                          out_cap=oc, variant=v,
+                          density=round(windows[wi].density, 4)):
+                with obs.span("local", category="device_execute"):
+                    part = _LOCAL_SYNC[v](
+                        sr, at, bt, jnp.asarray(lo, jnp.int32),
+                        jnp.asarray(hi, jnp.int32), flops_cap=fc,
+                        out_cap=oc, win_width=win_width,
+                        b_struct=b_struct,
+                        a_dense=a_dense if v != "block_xla" else None,
+                        bm=windows[wi].bm, bn=windows[wi].bn)
+                    obs.sync(part.vals)
+            block_parts.append(part)
+            blk_ub += min(int(oc), part.bcap * part.bm * part.bn)
+            _M_WINDOWS.inc()
+            _M_FLOPS.inc(fc)
+            continue
         with obs.span("window", w=wi, lo=lo, hi=hi, flops_cap=fc,
                       out_cap=oc, variant=v,
                       density=round(windows[wi].density, 4)
@@ -1321,23 +1562,61 @@ def _windows_sync(sr, a, b, at, bt, windows, win_width, b_struct,
         _M_FLOPS.inc(fc)
         _M_WIN_NNZ.observe(pn)
         _M_READBACK.inc(4)     # the pn scalar
+    if block_out:
+        return _block_concat_out(block_parts, a, b)
     with obs.span("sort", category="device_execute"):
-        if acc is None:                       # empty product
+        if acc is None and not block_parts:   # empty product
             out = tl.empty(a.tile_m, b.tile_n, fit(1, 128), a.dtype)
         else:
             # disjoint columns ⇒ no dedup; ONE sort restores (row, col)
-            # order and pushes the interleaved sentinel padding last
-            out, _ = _sort_compress(sr.add, *acc, jnp.int32(nlive),
+            # order and pushes the interleaved sentinel padding last.
+            # Block parts convert to COO HERE — the phase boundary —
+            # by flattening into the same sentinel-masked stream.
+            rows3, nlive_dev = _merge_block_parts(
+                acc, jnp.int32(nlive), block_parts, a, b)
+            out, _ = _sort_compress(sr.add, *rows3, nlive_dev,
                                     nrows=a.tile_m, ncols=b.tile_n,
-                                    cap=fit(nlive, cap_round),
+                                    cap=fit(nlive + blk_ub, cap_round),
                                     dedup=False)
         obs.sync(out.rows)
     return _fit_out_cap(out, out_cap, wrap)
 
 
+def _merge_block_parts(acc, nlive_dev, block_parts, a, b):
+    """Phase-boundary COO conversion: flatten each BlockTile part into
+    the sentinel-masked (rows, cols, vals) stream and concatenate with
+    the COO accumulator. Sentinels (row==nrows) sort last, so ONE
+    sort_compress over the concatenation restores global order exactly
+    as if every window had emitted COO."""
+    if not block_parts:
+        return acc, nlive_dev
+    streams = [] if acc is None else [acc]
+    for part in block_parts:
+        fr, fc, fv, fn = _block_flatten(part)
+        streams.append((fr, fc, fv))
+        nlive_dev = nlive_dev + fn
+    rows3 = tuple(jnp.concatenate([s[i] for s in streams])
+                  for i in range(3))
+    return rows3, nlive_dev
+
+
+def _block_concat_out(block_parts, a, b):
+    """``block_out`` tail: one BlockTile covering every window (blocks
+    stay sorted because windows are disjoint, ascending columns)."""
+    with obs.span("block_concat", category="device_execute"):
+        if block_parts:
+            outb = bk.concat_blocks(block_parts)
+        else:                                 # empty plan
+            bm, bn = block_shape()
+            outb = bk.empty(a.tile_m, b.tile_n, bm=bm, bn=bn, bcap=1,
+                            dtype=a.dtype)
+        obs.sync(outb.vals)
+    return outb
+
+
 def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
                    prune_hook, out_cap, cap_round, fit, wrap,
-                   variants=None, a_dense=None):
+                   variants=None, a_dense=None, block_out=False):
     """The async pipeline (default): see `_phased_1x1`'s docstring."""
     hook_meta = (a.grid, a.nrows, b.ncols)
     if variants is None:
@@ -1393,7 +1672,27 @@ def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
         _M_READBACK.inc(4)
         return pn
 
-    if len(windows) == 1 and out_cap is None:
+    def dispatch_block(wi, lo, hi, fc, oc):
+        """Enqueue one block window: no nnz handle — the BlockTile's
+        count stays on device and nothing downstream needs it before
+        the phase boundary."""
+        v = variants[wi]
+        with obs.span("window", w=wi, lo=lo, hi=hi, flops_cap=fc,
+                      out_cap=oc, variant=v,
+                      density=round(windows[wi].density, 4)):
+            with obs.span("local", category="dispatch"):
+                part = _LOCAL_ASYNC[v](
+                    sr, at, bt, jnp.asarray(lo, jnp.int32),
+                    jnp.asarray(hi, jnp.int32), flops_cap=fc,
+                    out_cap=oc, win_width=win_width, b_struct=b_struct,
+                    a_dense=a_dense if v != "block_xla" else None,
+                    bm=windows[wi].bm, bn=windows[wi].bn)
+        _M_WINDOWS.inc()
+        _M_FLOPS.inc(fc)
+        return part
+
+    if len(windows) == 1 and out_cap is None and not block_out \
+            and variants[0] not in BLOCK_VARIANTS:
         # single-window fast path: the window kernel's output is
         # already (row, col)-sorted and deduped — placement and the
         # final sort would be identity work. Shrink only if the count
@@ -1410,6 +1709,8 @@ def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
     off_dev = jnp.int32(0)   # DEVICE-carried live offset (exact)
     nlive_ub = 0        # host-known UPPER BOUND on the live prefix
     pending = None      # the one window whose placement is deferred
+    block_parts = []    # BlockTile per block window (disjoint columns)
+    blk_ub = 0          # host UPPER BOUND on block-part nnz (for caps)
 
     def place_async(item):
         nonlocal acc, off_dev, nlive_ub
@@ -1435,23 +1736,35 @@ def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
         nlive_ub += pn if pn is not None else new_cap
 
     for wi, (lo, hi, fc, oc) in enumerate(windows):
+        if variants[wi] in BLOCK_VARIANTS:
+            # block windows never enter the placement queue: their
+            # output stays in block form until the phase boundary
+            part = dispatch_block(wi, lo, hi, fc, oc)
+            block_parts.append(part)
+            blk_ub += min(int(oc), part.bcap * part.bm * part.bn)
+            continue
         item = dispatch_window(wi, lo, hi, fc, oc)
         if pending is not None:
             place_async(pending)   # w-1 placed while w is in flight
         pending = item
     if pending is not None:
         place_async(pending)
+    if block_out:
+        return _block_concat_out(block_parts, a, b)
     with obs.span("sort", category="device_execute"):
-        if acc is None:                       # empty product
+        if acc is None and not block_parts:   # empty product
             out = tl.empty(a.tile_m, b.tile_n, fit(1, 128), a.dtype)
         else:
             # disjoint columns ⇒ no dedup; ONE sort restores (row, col)
             # order and pushes the interleaved sentinel padding last.
             # nlive is the device-exact offset; the static cap uses the
             # host upper bound (== exact when every count was home).
-            out, _ = _sort_compress(sr.add, *acc, off_dev,
+            # Block parts convert to COO here — the phase boundary.
+            rows3, nlive_dev = _merge_block_parts(
+                acc, off_dev, block_parts, a, b)
+            out, _ = _sort_compress(sr.add, *rows3, nlive_dev,
                                     nrows=a.tile_m, ncols=b.tile_n,
-                                    cap=fit(nlive_ub, cap_round),
+                                    cap=fit(nlive_ub + blk_ub, cap_round),
                                     dedup=False)
         obs.sync(out.rows)
     return _fit_out_cap(out, out_cap, wrap)
@@ -1484,7 +1797,8 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
                   prune_hook: Optional[Callable[[DistSpMat], DistSpMat]] = None,
                   out_cap: Optional[int] = None,
                   cap_round: int = 4096,
-                  cap_ladder: Optional[CapLadder] = None) -> DistSpMat:
+                  cap_ladder: Optional[CapLadder] = None,
+                  block_out: bool = False):
     """C = A ⊗ B with B column-split into phases, each multiplied under
     its own flop budget, optionally pruned between phases, then
     concatenated (≅ MemEfficientSpGEMM, ParFriends.h:450-733).
@@ -1516,7 +1830,11 @@ def spgemm_phased(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
             return _phased_1x1(sr, a, b, phases=phases,
                                phase_flop_budget=phase_flop_budget,
                                prune_hook=prune_hook, out_cap=out_cap,
-                               cap_round=cap_round, cap_ladder=cap_ladder)
+                               cap_round=cap_round, cap_ladder=cap_ladder,
+                               block_out=block_out)
+    if block_out:
+        raise ValueError("block_out=True is 1x1-grid only: block tiles "
+                         "have no mesh placement path yet")
 
     def mult(bp, p, phases):
         return _planned_summa(sr, a, bp, cap_round,

@@ -264,6 +264,11 @@ def self_test() -> int:
     expect("dense zero-sort pin", {f.rule for f in fs},
            core.SORT_COUNT, core.SORT_ARITY)
 
+    print("fixture: bad_block_sort_budget.json")
+    fs = budget.run_budgets(files=[fx / "bad_block_sort_budget.json"])
+    expect("block zero-sort pin", {f.rule for f in fs},
+           core.SORT_COUNT, core.SORT_ARITY)
+
     print("fixture: bad_hybrid_bcast_budget.json")
     fs = budget.run_budgets(files=[fx / "bad_hybrid_bcast_budget.json"])
     expect("hybrid exchange collective ceiling", {f.rule for f in fs},

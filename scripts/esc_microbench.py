@@ -36,6 +36,18 @@ Every local row divides by the SAME denominator (the plan's summed
 per-window flops_cap, shared across variants by construction — the
 planner is variant-independent), so ns/slot stays comparable.
 
+Third section: the block-format (BCSR) window path
+(COMBBLAS_TPU_BLOCK_FORMAT = block|auto, ops.blocktile) swept over
+(bm, bn) in {8x128, 16x128, 32x128} on BOTH local workloads — forced
+block on the sparse R-MAT shows the misfit cost the planner avoids,
+forced block on the near-dense square is the headline: the planned
+block path must beat the PR-8 `dense_mxu` row end-to-end (it skips
+the per-window COO materialization that variant still pays; the one
+flatten+sort lands at the phase boundary). `block_auto` shows the
+density/cost-model/mem-ledger fmt decision picking block on its own.
+Identical c_nnz stays asserted across every row of a workload,
+block rows included.
+
 Usage: esc_microbench.py [--scale 14] [--reps 7] [--budget-log2 22]
                          [--dense-n 256] [--local-reps 5]
                          [--out ESC_MICROBENCH.json]
@@ -167,7 +179,9 @@ def main():
     dvals[rngd.random((nd, nd)) > args.dense_density] = 0.0
     amcl = dm.from_dense(S.PLUS, grid, dvals, 0.0, cap=nd * nd)
 
-    _LOCAL_ENV = ("COMBBLAS_TPU_LOCAL_VARIANT", "COMBBLAS_TPU_MXU_FLOAT")
+    _LOCAL_ENV = ("COMBBLAS_TPU_LOCAL_VARIANT", "COMBBLAS_TPU_MXU_FLOAT",
+                  "COMBBLAS_TPU_BLOCK_FORMAT", "COMBBLAS_TPU_BLOCK_SHAPE",
+                  "COMBBLAS_TPU_PALLAS_BLOCK")
 
     def measure_local(workload, name, env, runner, slots):
         for k in _LOCAL_ENV:
@@ -234,6 +248,23 @@ def main():
          phased(amcl, phases=2), nd_slots),
     ]
 
+    # ---- section 3: block-format (BCSR) sweep ---------------------------
+    # forced block at each (bm, bn) on both workloads + the planner's own
+    # fmt decision (auto); same runners, same slots, same c_nnz assert
+    _BLOCK_SHAPES = ("8x128", "16x128", "32x128")
+    for bmn in _BLOCK_SHAPES:
+        benv = {"COMBBLAS_TPU_BLOCK_FORMAT": "block",
+                "COMBBLAS_TPU_BLOCK_SHAPE": bmn,
+                "COMBBLAS_TPU_MXU_FLOAT": "1"}
+        local_rows.append(("near_dense", f"block_{bmn}", benv,
+                           phased(amcl, phases=2), nd_slots))
+        local_rows.append(("sparse", f"block_{bmn}", benv,
+                           phased(asp, phases=4), sparse_slots))
+    local_rows.append(("near_dense", "block_auto",
+                       {"COMBBLAS_TPU_BLOCK_FORMAT": "auto",
+                        "COMBBLAS_TPU_MXU_FLOAT": "1"},
+                       phased(amcl, phases=2), nd_slots))
+
     obs.reset()
     obs.ledger.reset()
     obs.set_enabled(True)
@@ -243,6 +274,8 @@ def main():
         for wl, name, env, runner, slots in local_rows:
             local.setdefault(wl, {})[name] = measure_local(
                 wl, name, env, runner, slots)
+        # full bench-registry schema needs the span residual too
+        unaccounted = round(float(obs.export.unaccounted_s()), 4)
     finally:
         obs.set_enabled(False)
     dispatches = obs.export.dispatch_summary()
@@ -265,6 +298,15 @@ def main():
     nd_speedup = round(
         local["near_dense"]["fused_xla"]["seconds_median"]
         / local["near_dense"][nd_best]["seconds_median"], 3)
+    blk_names = [f"block_{s}" for s in _BLOCK_SHAPES]
+    nd_block_best = min(blk_names,
+                        key=lambda v: local["near_dense"][v]["seconds_median"])
+    block_vs_mxu = round(
+        local["near_dense"]["dense_mxu"]["seconds_median"]
+        / local["near_dense"][nd_block_best]["seconds_median"], 3)
+    sp_block_cost = round(
+        min(local["sparse"][v]["seconds_median"] for v in blk_names)
+        / local["sparse"]["esc"]["seconds_median"], 3)
 
     before = recs["2key"]
     after = recs.get("fused_pallas", recs["fused_xla"])
@@ -284,6 +326,10 @@ def main():
             "near_dense_speedup_vs_fused_xla": nd_speedup,
             "sparse_scale": args.local_scale,
             "sparse_slots": sparse_slots, "near_dense_slots": nd_slots,
+            "near_dense_block_best": nd_block_best,
+            "near_dense_block_speedup_vs_dense_mxu": block_vs_mxu,
+            "sparse_block_cost_vs_esc": sp_block_cost,
+            "block_shapes": list(blk_names),
             "note": "near-dense speedup compares the phased loop's best "
                     "sort-free variant against the whole-tile fused_xla "
                     "ESC at the SAME summed flops_cap; identical c_nnz "
@@ -291,6 +337,7 @@ def main():
         },
         "dispatch_summary": dispatches,
         "memory_summary": memory,
+        "unaccounted_s": unaccounted,
         "roofline": dispatches.get("efficiency"),
         "note": "median wall time of the full jitted ESC SpGEMM "
                 "(expand + sort + dedup + re-sort) divided by flops_cap; "

@@ -437,6 +437,84 @@ def run_esc_check(grid) -> bool:
     return ok
 
 
+def run_block_check(grid) -> bool:
+    """Step 0e: block-format smoke — one tiny phased A*A under every
+    COMBBLAS_TPU_BLOCK_FORMAT value; every format must agree
+    BIT-EXACTLY with the coo/esc reference, and the forced block run
+    must land spgemm.block/* window dispatches on the ledger."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm, spgemm as spg
+
+    step("0e. block-sparse tile format smoke (--block)")
+    ok = True
+    n = 1 << 8
+    r, c = generate.rmat_edges(jax.random.key(7), 8, 8)
+    a = dm.from_global_coo(S.PLUS, grid, r, c,
+                           jnp.ones_like(r, jnp.float32), n, n)
+
+    def triples(cm):
+        k = int(np.asarray(cm.nnz[0, 0]))
+        return (np.asarray(cm.rows[0, 0])[:k],
+                np.asarray(cm.cols[0, 0])[:k],
+                np.asarray(cm.vals[0, 0])[:k])
+
+    saved = {k: os.environ.get(k)
+             for k in ("COMBBLAS_TPU_BLOCK_FORMAT",
+                       "COMBBLAS_TPU_LOCAL_VARIANT",
+                       "COMBBLAS_TPU_MXU_FLOAT")}
+    results, ledgers = {}, {}
+    try:
+        os.environ["COMBBLAS_TPU_LOCAL_VARIANT"] = "auto"
+        os.environ["COMBBLAS_TPU_MXU_FLOAT"] = "1"
+        for fmt in ("coo", "block", "auto"):
+            os.environ["COMBBLAS_TPU_BLOCK_FORMAT"] = fmt
+            obs.reset()
+            obs.ledger.LEDGER.reset()
+            obs.set_enabled(True)
+            try:
+                cm = spg.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2)
+                cm.vals.block_until_ready()
+                results[fmt] = triples(cm)
+                ledgers[fmt] = sorted(
+                    {x.name for x in obs.ledger.LEDGER.snapshot()
+                     if x.name.startswith(("spgemm.colwindow",
+                                           "spgemm.block"))})
+            finally:
+                obs.set_enabled(False)
+                obs.reset()
+                obs.ledger.LEDGER.reset()
+            print(f"  {fmt}: c_nnz={len(results[fmt][0])} "
+                  f"windows={ledgers[fmt]}")
+    except Exception:
+        traceback.print_exc()
+        return False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    ref = results["coo"]
+    for fmt in ("block", "auto"):
+        for got, want in zip(results[fmt], ref):
+            if not np.array_equal(got, want):
+                print(f"FAIL: {fmt} diverged from the coo reference")
+                ok = False
+                break
+    if not any(nm.startswith("spgemm.block/") for nm in ledgers["block"]):
+        print(f"FAIL: forced block never dispatched spgemm.block/* "
+              f"(ledger: {ledgers['block']})")
+        ok = False
+    print("block format:", "OK" if ok else "FAILED")
+    return ok
+
+
 def run_mem_check(grid) -> bool:
     """Step 0g: memory-ledger smoke — one tiny phased A*A with the
     footprint census on; the census must cover every in-wrapper
@@ -678,6 +756,12 @@ def main():
                          "under each COMBBLAS_TPU_LOCAL_VARIANT value; "
                          "all variants must match the esc reference "
                          "bit-exactly")
+    ap.add_argument("--block", action="store_true",
+                    help="block-sparse tile smoke: tiny phased A*A "
+                         "under each COMBBLAS_TPU_BLOCK_FORMAT value; "
+                         "all formats must match the coo reference "
+                         "bit-exactly and forced block must dispatch "
+                         "spgemm.block/* window kernels")
     ap.add_argument("--mesh", action="store_true",
                     help="scale-out smoke on a 2x2 submesh: serve "
                          "bits path resolves, mesh packed-bit batch "
@@ -718,6 +802,8 @@ def main():
     if args.mcl and not run_mcl_check(grid):
         sys.exit(1)
     if args.esc and not run_esc_check(grid):
+        sys.exit(1)
+    if args.block and not run_block_check(grid):
         sys.exit(1)
     if args.mesh and not run_mesh_check():
         sys.exit(1)

@@ -1,0 +1,544 @@
+"""Block-sparse (BCSR) tile format: COO<->block converter round-trips
+(ragged edges, empty blocks, monoid-zero vs explicit-zero, overflow
+drop order), block window-kernel parity vs the ESC reference across
+every in-gate semiring x kernel body (xla scatter / MXU matmul /
+Pallas interpret), the planner's fmt decision (once-per-plan env
+resolution, mem-ledger rejection, legacy 4-tuple protocol), loop-level
+parity through both window loops, the ``block_out`` BlockTile surface,
+MCL's block EWise wiring, the canonical shape-independent reduce, and
+the no-remint jit-cache contract across fmt decisions."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu.ops import blocktile as bk
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.ops import tile as tl
+from combblas_tpu.parallel import distmat as DM
+from combblas_tpu.parallel import spgemm as SPG
+from combblas_tpu.parallel.grid import ProcGrid
+
+
+@pytest.fixture(scope="module")
+def grid11():
+    return ProcGrid.make(1, 1, jax.devices()[:1])
+
+
+def _tile(rng, n, density, dtype="f32"):
+    """Random n x n tile; int-valued floats keep every sum exactly
+    representable, so even the reassociating MXU matmul is bit-exact."""
+    m = rng.random((n, n)) < density
+    r, c = np.nonzero(m)
+    if dtype == "bool":
+        vals = np.ones(len(r), bool)
+        add = S.LOR
+    elif dtype == "i32":
+        vals = rng.integers(1, 5, len(r)).astype(np.int32)
+        add = S.PLUS
+    else:
+        vals = rng.integers(1, 5, len(r)).astype(np.float32)
+        add = S.PLUS
+    cap = max(64, 1 << int(np.ceil(np.log2(max(len(r), 1)))))
+    return tl.from_coo(add, jnp.asarray(r), jnp.asarray(c),
+                       jnp.asarray(vals), nrows=n, ncols=n, cap=cap)
+
+
+def _triples(t):
+    n = int(np.asarray(t.nnz))
+    return (n, np.asarray(t.rows)[:n].tolist(),
+            np.asarray(t.cols)[:n].tolist(),
+            np.asarray(t.vals)[:n].tolist())
+
+
+def _assert_tile_equal(got, ref, msg=""):
+    assert _triples(got) == _triples(ref), msg
+
+
+SEMIRINGS = [
+    ("plus_times_f32", S.PLUS_TIMES_F32, "f32", "f32"),
+    ("plus_times_i32", S.PLUS_TIMES_I32, "i32", "i32"),
+    ("min_plus", S.MIN_PLUS_F32, "f32", "f32"),
+    ("bool_or_and", S.BOOL_OR_AND, "bool", "bool"),
+    ("select2nd_mixed", S.SELECT2ND_MAX_I32, "bool", "i32"),
+]
+
+
+class TestConverters:
+    """COO<->block round trips: the bit-exactness boundary."""
+
+    @pytest.mark.parametrize("n,bm,bn", [(37, 8, 16), (32, 8, 16),
+                                         (40, 16, 128)])
+    def test_roundtrip_ragged_and_aligned(self, rng, n, bm, bn):
+        """Ragged edges (n not a multiple of bm or bn) and aligned
+        shapes both round-trip bit-exactly through the block format."""
+        t = _tile(rng, n, 0.2)
+        nbr, nbc = -(-n // bm), -(-n // bn)
+        b = bk.to_blocks(S.PLUS, t, bm=bm, bn=bn, bcap=nbr * nbc)
+        back = bk.from_blocks(S.PLUS, b, cap=t.cap)
+        _assert_tile_equal(back, t, f"roundtrip n={n} {bm}x{bn}")
+        assert int(np.asarray(b.nnz())) == int(np.asarray(t.nnz))
+
+    def test_empty_tile_and_empty_blocks(self, rng):
+        t = tl.empty(24, 24, 64, jnp.float32)
+        b = bk.to_blocks(S.PLUS, t, bm=8, bn=16, bcap=6)
+        assert int(np.asarray(b.nblk)) == 0
+        assert int(np.asarray(b.nnz())) == 0
+        # dead slots carry the (nrows, ncols) sentinel, like Tile pads
+        assert np.all(np.asarray(b.rstart) == 24)
+        assert np.all(np.asarray(b.cstart) == 24)
+        back = bk.from_blocks(S.PLUS, b, cap=64)
+        assert int(np.asarray(back.nnz)) == 0
+        # bk.empty constructs the same sentinel layout directly
+        e = bk.empty(24, 24, bm=8, bn=16, bcap=2)
+        assert int(np.asarray(e.nnz())) == 0
+
+    def test_monoid_zero_padding_vs_explicit_zero(self, rng):
+        """Untouched cells carry the ADD identity (not 0.0), and a
+        stored explicit zero survives the round trip — structure is
+        carried by the touched plane, never by value comparison."""
+        r = jnp.asarray([0, 3, 9], jnp.int32)
+        c = jnp.asarray([1, 2, 9], jnp.int32)
+        v = jnp.asarray([2.0, 0.0, 5.0], jnp.float32)   # explicit zero
+        t = tl.from_coo(S.PLUS, r, c, v, nrows=12, ncols=12, cap=8)
+        assert int(np.asarray(t.nnz)) == 3
+        for add in (S.PLUS, S.MIN):
+            b = bk.to_blocks(add, t, bm=4, bn=4, bcap=9)
+            ident = float(add.identity_scalar(jnp.float32))
+            vals = np.asarray(b.vals)
+            touched = np.asarray(b.touched) > 0
+            live = np.arange(b.bcap) < int(np.asarray(b.nblk))
+            # every untouched cell of a live block holds the identity
+            assert np.all(vals[live][~touched[live]] == ident), add.name
+            back = bk.from_blocks(add, b, cap=8)
+            _assert_tile_equal(back, t, f"explicit zero lost ({add.name})")
+
+    def test_to_blocks_overflow_drops_largest_blocks(self, rng):
+        """Block-capacity saturation drops the LARGEST block ids, whole
+        blocks at a time — the block-granular analogue of `from_coo`'s
+        largest-(row, col) drop."""
+        # one entry per 4x4 block on the diagonal of a 16x16 tile:
+        # blocks (0,0), (1,1), (2,2), (3,3)
+        r = c = jnp.asarray([0, 5, 10, 15], jnp.int32)
+        v = jnp.asarray([1.0, 2.0, 3.0, 4.0], jnp.float32)
+        t = tl.from_coo(S.PLUS, r, c, v, nrows=16, ncols=16, cap=8)
+        b = bk.to_blocks(S.PLUS, t, bm=4, bn=4, bcap=2)
+        assert int(np.asarray(b.nblk)) == 2
+        back = bk.from_blocks(S.PLUS, b, cap=8)
+        # the two SMALLEST block ids survive
+        assert _triples(back) == (2, [0, 5], [0, 5], [1.0, 2.0])
+
+    def test_from_blocks_cap_overflow_matches_esc_order(self, rng):
+        """from_blocks routes through tl.from_coo, so output-capacity
+        truncation drops the largest (row, col) — the ESC contract."""
+        t = _tile(rng, 24, 0.3)
+        full = _triples(t)
+        nbr = -(-24 // 8)
+        b = bk.to_blocks(S.PLUS, t, bm=8, bn=8, bcap=nbr * 3)
+        cap = 16
+        assert full[0] > cap    # genuinely overflows
+        got = bk.from_blocks(S.PLUS, b, cap=cap)
+        want = (cap, full[1][:cap], full[2][:cap], full[3][:cap])
+        assert _triples(got) == want
+
+    def test_flatten_and_concat(self, rng):
+        """`flatten` renders the sentinel-masked merge format; eager
+        `concat_blocks` restores the (rstart, cstart) sort order."""
+        t = _tile(rng, 16, 0.3)
+        b = bk.to_blocks(S.PLUS, t, bm=8, bn=8, bcap=4)
+        rows, cols, vals, nlive = bk.flatten(b)
+        assert int(np.asarray(nlive)) == int(np.asarray(t.nnz))
+        dead = (np.asarray(rows) == 16)
+        assert np.all((np.asarray(cols) == 16) == dead)
+        assert np.all(np.asarray(vals)[dead] == 0)
+        # split by block rows, concat in reverse, order restored
+        lo = dataclasses.replace(
+            b, rstart=b.rstart[:2], cstart=b.cstart[:2], vals=b.vals[:2],
+            touched=b.touched[:2],
+            nblk=jnp.minimum(b.nblk, 2))
+        hi_n = jnp.maximum(b.nblk - 2, 0)
+        hi = dataclasses.replace(
+            b, rstart=b.rstart[2:], cstart=b.cstart[2:], vals=b.vals[2:],
+            touched=b.touched[2:], nblk=hi_n)
+        cat = bk.concat_blocks([hi, lo])
+        _assert_tile_equal(bk.from_blocks(S.PLUS, cat, cap=t.cap), t,
+                           "concat order")
+
+    def test_transpose(self, rng):
+        t = _tile(rng, 20, 0.3)
+        b = bk.to_blocks(S.PLUS, t, bm=4, bn=8, bcap=15)
+        bt_ = bk.transpose(b)
+        dense = np.asarray(bk.to_dense(b))
+        np.testing.assert_array_equal(np.asarray(bk.to_dense(bt_)),
+                                      dense.T)
+        assert (bt_.bm, bt_.bn) == (8, 4)
+
+
+class TestBlockKernelParity:
+    """`_spgemm_colwindow_block_impl` (xla / mxu / pallas-interpret)
+    returns the SAME stored set as `tl.spgemm_colwindow` (ESC) once
+    rendered back to COO — including float plus-times on the non-MXU
+    bodies (expansion-order combines)."""
+
+    KW = dict(flops_cap=1 << 14, win_width=16)
+
+    @pytest.mark.parametrize("name,sr,adt,bdt", SEMIRINGS,
+                             ids=[s[0] for s in SEMIRINGS])
+    def test_block_xla_matches_esc(self, rng, name, sr, adt, bdt):
+        n = 32
+        a = _tile(rng, n, 0.35, adt)
+        b = _tile(rng, n, 0.35, bdt)
+        clo, chi = jnp.int32(4), jnp.int32(20)
+        esc = tl.spgemm_colwindow(sr, a, b, clo, chi, out_cap=1 << 10,
+                                  **self.KW)
+        blk = bk._spgemm_colwindow_block_impl(sr, a, b, clo, chi,
+                                              bm=8, bn=128,
+                                              pallas_mode="off",
+                                              **self.KW)
+        got = bk.from_blocks(sr.add, blk, cap=1 << 10)
+        _assert_tile_equal(got, esc, f"{name} block_xla != esc")
+
+    @pytest.mark.parametrize("dt", ["f32", "i32"])
+    def test_block_mxu_matches_esc(self, rng, dt):
+        n = 32
+        a = _tile(rng, n, 0.35, dt)
+        b = _tile(rng, n, 0.35, dt)
+        sr = S.PLUS_TIMES_F32 if dt == "f32" else S.PLUS_TIMES_I32
+        clo, chi = jnp.int32(4), jnp.int32(20)
+        esc = tl.spgemm_colwindow(sr, a, b, clo, chi, out_cap=1 << 10,
+                                  **self.KW)
+        blk = bk._spgemm_colwindow_block_impl(sr, a, b, clo, chi,
+                                              bm=8, bn=128, mxu=True,
+                                              pallas_mode="off",
+                                              **self.KW)
+        got = bk.from_blocks(sr.add, blk, cap=1 << 10)
+        _assert_tile_equal(got, esc, f"{dt} block_mxu != esc")
+        # hoisted a_dense must give the same answer
+        ad = tl.densify_operand(a, dtype=esc.dtype)
+        blk2 = bk._spgemm_colwindow_block_impl(sr, a, b, clo, chi,
+                                               bm=8, bn=128, mxu=True,
+                                               a_dense=ad,
+                                               pallas_mode="off",
+                                               **self.KW)
+        _assert_tile_equal(bk.from_blocks(sr.add, blk2, cap=1 << 10),
+                           esc, f"{dt} block_mxu(a_dense) != esc")
+
+    @pytest.mark.parametrize("name,sr,adt,bdt", SEMIRINGS,
+                             ids=[s[0] for s in SEMIRINGS])
+    def test_block_pallas_interpret_matches_esc(self, rng, name, sr,
+                                                adt, bdt, monkeypatch):
+        monkeypatch.setenv("COMBBLAS_TPU_PALLAS_BLOCK", "interpret")
+        n = 32
+        a = _tile(rng, n, 0.35, adt)
+        b = _tile(rng, n, 0.35, bdt)
+        clo, chi = jnp.int32(4), jnp.int32(20)
+        esc = tl.spgemm_colwindow(sr, a, b, clo, chi, out_cap=1 << 10,
+                                  **self.KW)
+        blk = bk.spgemm_colwindow_block(sr, a, b, clo, chi,
+                                        bm=8, bn=128, **self.KW)
+        got = bk.from_blocks(sr.add, blk, cap=1 << 10)
+        _assert_tile_equal(got, esc, f"{name} block_pallas != esc")
+
+    def test_empty_window_and_full_tile(self, rng):
+        a = _tile(rng, 32, 0.35)
+        clo = chi = jnp.int32(10)
+        blk = bk._spgemm_colwindow_block_impl(
+            S.PLUS_TIMES_F32, a, a, clo, chi, bm=8, bn=128,
+            pallas_mode="off", **self.KW)
+        assert int(np.asarray(blk.nnz())) == 0
+
+    def test_user_monoid_raises(self, rng):
+        a = _tile(rng, 16, 0.3)
+        user = S.Semiring("user_plus_times",
+                          S.Monoid("uplus", jax.lax.add, 0, kind=None),
+                          jax.lax.mul, jnp.float32)
+        with pytest.raises(ValueError, match="monoid kind"):
+            bk._spgemm_colwindow_block_impl(
+                user, a, a, jnp.int32(0), jnp.int32(16), bm=8, bn=128,
+                flops_cap=256, win_width=16, pallas_mode="off")
+        with pytest.raises(ValueError, match="mxu"):
+            bk._spgemm_colwindow_block_impl(
+                S.MIN_PLUS_F32, a, a, jnp.int32(0), jnp.int32(16),
+                bm=8, bn=128, mxu=True, flops_cap=256, win_width=16,
+                pallas_mode="off")
+
+
+class TestPlannerFmt:
+    """The planner's per-window tile-format decision: env knobs
+    resolved ONCE per plan and recorded on the rows, the PR-11 mem
+    gate, and the legacy 4-tuple protocol."""
+
+    def _mat(self, rng, grid11, n=32, density=0.5):
+        da = (rng.random((n, n)) < density).astype(np.float32)
+        return DM.from_dense(S.PLUS, grid11, da, 0.0)
+
+    def test_fmt_recorded_with_thresholds(self, rng, grid11,
+                                          monkeypatch):
+        a = self._mat(rng, grid11)
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_SHAPE", "16x128")
+        for w in SPG.plan_colwindows(a, a, phases=2):
+            assert w.fmt == "block"
+            assert (w.bm, w.bn) == (16, 128)
+            assert w.block_thr == SPG.block_threshold()
+            lo, hi, fc, oc = w      # legacy protocol intact
+            assert len(w) == 4
+
+    def test_auto_fmt_tracks_density(self, rng, grid11, monkeypatch):
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "auto")
+        thr = SPG.block_threshold()
+        dense = self._mat(rng, grid11, density=0.6)
+        for w in SPG.plan_colwindows(dense, dense, phases=2):
+            assert w.density >= thr and w.fmt == "block", w
+        sparse = self._mat(rng, grid11, n=64, density=0.02)
+        for w in SPG.plan_colwindows(sparse, sparse, phases=2):
+            assert w.density < thr and w.fmt == "coo", w
+
+    def test_default_is_coo(self, rng, grid11, monkeypatch):
+        monkeypatch.delenv("COMBBLAS_TPU_BLOCK_FORMAT", raising=False)
+        a = self._mat(rng, grid11)
+        assert all(w.fmt == "coo"
+                   for w in SPG.plan_colwindows(a, a, phases=2))
+
+    def test_env_validation(self, rng, grid11, monkeypatch):
+        a = self._mat(rng, grid11)
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "bogus")
+        with pytest.raises(ValueError, match="BLOCK_FORMAT"):
+            SPG.plan_colwindows(a, a, phases=2)
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        for bad in ("7x128", "8x64", "8x", "x128"):
+            monkeypatch.setenv("COMBBLAS_TPU_BLOCK_SHAPE", bad)
+            with pytest.raises(ValueError, match="BLOCK_SHAPE"):
+                SPG.plan_colwindows(a, a, phases=2)
+
+    def test_mem_gate_rejects_to_coo(self, rng, grid11, monkeypatch):
+        """A block shape whose temp bytes blow the ledger ceiling is
+        rejected AT PLAN TIME: the window stays on the COO path and the
+        planner counts the rejection."""
+        a = self._mat(rng, grid11)
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        monkeypatch.setattr(SPG, "_block_plan_ok",
+                            lambda *args, **kw: False)
+        windows = SPG.plan_colwindows(a, a, phases=2)
+        assert all(w.fmt == "coo" for w in windows)
+
+    def test_resolver_demotes_block_on_hook(self, rng, grid11,
+                                            monkeypatch):
+        """The prune hook's surface is COO-typed: block windows demote
+        to their coo proposal when a hook is present."""
+        a = self._mat(rng, grid11)
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        windows = SPG.plan_colwindows(a, a, phases=2)
+        at = tl.Tile(a.rows[0, 0], a.cols[0, 0], a.vals[0, 0],
+                     a.nnz[0, 0], a.tile_m, a.tile_n)
+        win_width = max(w.hi - w.lo for w in windows)
+        free = SPG._resolve_variants(S.PLUS_TIMES_F32, windows,
+                                     win_width, at, at)
+        assert all(v in SPG.BLOCK_VARIANTS for v in free)
+        hooked = SPG._resolve_variants(S.PLUS_TIMES_F32, windows,
+                                       win_width, at, at,
+                                       have_hook=True)
+        assert all(v not in SPG.BLOCK_VARIANTS for v in hooked)
+
+
+class TestBlockLoops:
+    """spgemm_phased with block-format windows through BOTH loops:
+    identical stored set to the ESC + sync + coo reference."""
+
+    def _ref(self, sr, a, b, phases, monkeypatch, **kw):
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "esc")
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "1")
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "coo")
+        return self._triples(SPG.spgemm_phased(sr, a, b, phases=phases,
+                                               **kw))
+
+    @staticmethod
+    def _triples(c):
+        n = int(np.asarray(c.nnz[0, 0]))
+        return (n, np.asarray(c.rows[0, 0])[:n].tolist(),
+                np.asarray(c.cols[0, 0])[:n].tolist(),
+                np.asarray(c.vals[0, 0])[:n].tolist())
+
+    @staticmethod
+    def _dist(rng, grid11, n, density, dt):
+        mask = rng.random((n, n)) < density
+        if dt == "bool":
+            return DM.from_dense(S.LOR, grid11, mask, False)
+        v = np.where(mask, rng.integers(1, 5, (n, n)), 0)
+        return DM.from_dense(S.PLUS, grid11,
+                             v.astype(np.float32 if dt == "f32"
+                                      else np.int32),
+                             0.0 if dt == "f32" else 0)
+
+    @pytest.mark.parametrize("name,sr,adt,bdt", SEMIRINGS,
+                             ids=[s[0] for s in SEMIRINGS])
+    def test_block_format_both_loops(self, rng, grid11, name, sr, adt,
+                                     bdt, monkeypatch):
+        n = 32
+        a = self._dist(rng, grid11, n, 0.4, adt)
+        b = self._dist(rng, grid11, n, 0.4, bdt)
+        ref = self._ref(sr, a, b, 2, monkeypatch)
+        for fmt in ("block", "auto"):
+            for sync in ("0", "1"):
+                monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+                monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", fmt)
+                monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", sync)
+                c = SPG.spgemm_phased(sr, a, b, phases=2)
+                assert self._triples(c) == ref, \
+                    f"{name} fmt={fmt} sync={sync}"
+
+    def test_block_pallas_loop(self, rng, grid11, monkeypatch):
+        a = self._dist(rng, grid11, 32, 0.4, "f32")
+        ref = self._ref(S.PLUS_TIMES_F32, a, a, 2, monkeypatch)
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        monkeypatch.setenv("COMBBLAS_TPU_PALLAS_BLOCK", "interpret")
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+        c = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2)
+        assert self._triples(c) == ref, "block pallas loop"
+
+    def test_block_ledger_names(self, rng, grid11, monkeypatch):
+        from combblas_tpu import obs
+        a = self._dist(rng, grid11, 32, 0.4, "i32")
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+        was = obs.enabled()
+        obs.set_enabled(True)
+        obs.ledger.reset()
+        try:
+            SPG.spgemm_phased(S.PLUS_TIMES_I32, a, a, phases=2)
+            names = [r.name for r in obs.ledger.LEDGER.snapshot()]
+            assert any(n.startswith("spgemm.block/") for n in names), \
+                names
+        finally:
+            obs.set_enabled(was)
+            obs.ledger.reset()
+
+    def test_block_out_returns_blocktile(self, rng, grid11,
+                                         monkeypatch):
+        """``block_out=True`` hands back ONE concatenated BlockTile —
+        no COO materialization at the phase boundary."""
+        a = self._dist(rng, grid11, 32, 0.4, "f32")
+        ref = self._ref(S.PLUS_TIMES_F32, a, a, 2, monkeypatch)
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "block")
+        for sync in ("0", "1"):
+            monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", sync)
+            out = SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                                    block_out=True)
+            assert isinstance(out, bk.BlockTile)
+            got = bk.from_blocks(S.PLUS, out, cap=1 << int(np.ceil(
+                np.log2(max(ref[0], 2)))))
+            assert _triples(got) == ref, f"block_out sync={sync}"
+
+    def test_block_out_requires_block_plan(self, rng, grid11,
+                                           monkeypatch):
+        a = self._dist(rng, grid11, 32, 0.4, "f32")
+        monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", "coo")
+        with pytest.raises(ValueError, match="block_out"):
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                              block_out=True)
+
+
+class TestBlockAlgebra:
+    """tile_algebra's format dispatch + MCL's block EWise surface +
+    the canonical shape-independent reduce."""
+
+    def test_reduce_shape_independent_and_int_exact(self, rng):
+        """reduce is a canonical dense fold over (nrows, ncols): the
+        result is bit-identical across block shapes, and order-
+        insensitive monoids match the COO path exactly."""
+        from combblas_tpu.ops import tile_algebra as talg
+        t = _tile(rng, 32, 0.4, "i32")
+        sums_coo = np.asarray(talg.reduce(S.PLUS, t, "col"))
+        for bm, bn in ((8, 8), (8, 16), (16, 32)):
+            b = bk.to_blocks(S.PLUS, t, bm=bm, bn=bn,
+                             bcap=(-(-32 // bm)) * (-(-32 // bn)))
+            np.testing.assert_array_equal(
+                np.asarray(talg.reduce(S.PLUS, b, "col")), sums_coo,
+                err_msg=f"i32 col reduce {bm}x{bn}")
+        # f32: identical across shapes (may differ from COO in the ulp)
+        tf = _tile(rng, 32, 0.4, "f32")
+        outs = []
+        for bm in (8, 32):
+            b = bk.to_blocks(S.PLUS, tf, bm=bm, bn=16,
+                             bcap=(-(-32 // bm)) * 2)
+            outs.append(np.asarray(bk.reduce(S.PLUS, b, "col")))
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_tile_algebra_dispatch(self, rng):
+        """apply / dim_apply / prune_column route BlockTile inputs to
+        the block implementations with COO-identical stored sets."""
+        from combblas_tpu.ops import tile_algebra as talg
+        t = _tile(rng, 24, 0.35, "f32")
+        b = bk.to_blocks(S.PLUS, t, bm=8, bn=8, bcap=9)
+        sq = talg.apply(b, jnp.square)
+        _assert_tile_equal(bk.from_blocks(S.PLUS, sq, cap=t.cap),
+                           talg.apply(t, jnp.square), "apply")
+        vec = jnp.arange(1, 25, dtype=jnp.float32)
+        sc = talg.dim_apply(b, "col", vec, jax.lax.mul)
+        _assert_tile_equal(bk.from_blocks(S.PLUS, sc, cap=t.cap),
+                           talg.dim_apply(t, "col", vec, jax.lax.mul),
+                           "dim_apply")
+        thr = jnp.full((24,), 2.5, jnp.float32)
+        pr = talg.prune_column(b, thr, jax.lax.lt, add=S.PLUS)
+        _assert_tile_equal(bk.from_blocks(S.PLUS, pr, cap=t.cap),
+                           talg.prune_column(t, thr, jax.lax.lt),
+                           "prune_column")
+
+    def test_mcl_block_surface(self, rng, grid11):
+        """inflate/col-stochastic on blocks: exact structure, values to
+        f32 rounding (the documented last-ulp PLUS caveat)."""
+        from combblas_tpu.models import mcl
+        from combblas_tpu.ops import tile_algebra as talg
+        da = np.where(rng.random((24, 24)) < 0.35,
+                      rng.integers(1, 5, (24, 24)), 0).astype(np.float32)
+        m = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        t = tl.Tile(m.rows[0, 0], m.cols[0, 0], m.vals[0, 0],
+                    m.nnz[0, 0], m.tile_m, m.tile_n)
+        b = bk.to_blocks(S.PLUS, t, bm=8, bn=8, bcap=9)
+        refm = mcl.inflate(m, 2.0)
+        ref = tl.Tile(refm.rows[0, 0], refm.cols[0, 0], refm.vals[0, 0],
+                      refm.nnz[0, 0], refm.tile_m, refm.tile_n)
+        got = bk.from_blocks(S.PLUS, mcl.inflate_block(b, 2.0),
+                             cap=t.cap)
+        rn, rr, rc, rv = _triples(ref)
+        gn, gr, gc, gv = _triples(got)
+        assert (gn, gr, gc) == (rn, rr, rc)
+        np.testing.assert_allclose(gv, rv, rtol=1e-6)
+        # col sums of the block-stochastic matrix are ~1 on live cols
+        sums = np.asarray(talg.reduce(
+            S.PLUS, mcl.make_col_stochastic_block(b), "col"))
+        live = sums > 0
+        np.testing.assert_allclose(sums[live], 1.0, rtol=1e-6)
+
+
+class TestNoRemint:
+    """fmt decisions cannot mint unbounded recompiles: a second sweep
+    over every COMBBLAS_TPU_BLOCK_FORMAT value hits the jit caches."""
+
+    def test_fmt_decisions_do_not_remint(self, rng, grid11,
+                                         monkeypatch):
+        da = (rng.random((32, 32)) < 0.4).astype(np.float32)
+        a = DM.from_dense(S.PLUS, grid11, da, 0.0)
+        lad = SPG.CapLadder()
+        monkeypatch.setenv("COMBBLAS_TPU_SYNC_WINDOWS", "0")
+        monkeypatch.setenv("COMBBLAS_TPU_LOCAL_VARIANT", "auto")
+        caches = [tl.spgemm_colwindow, tl.spgemm_colwindow_dense,
+                  bk.spgemm_colwindow_block]
+        for fmt in ("coo", "block", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", fmt)
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                              cap_ladder=lad)
+        sizes = [f._cache_size() for f in caches]
+        rungs = sorted(lad.rungs)
+        for fmt in ("coo", "block", "auto"):
+            monkeypatch.setenv("COMBBLAS_TPU_BLOCK_FORMAT", fmt)
+            SPG.spgemm_phased(S.PLUS_TIMES_F32, a, a, phases=2,
+                              cap_ladder=lad)
+        assert [f._cache_size() for f in caches] == sizes
+        assert sorted(lad.rungs) == rungs
