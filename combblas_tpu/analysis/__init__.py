@@ -1,7 +1,7 @@
 """`combblas_tpu.analysis` — static-analysis gate for the repo's
 structural invariants.
 
-Eight passes, one verdict (see `scripts/analyze.py --gate` and the
+Nine passes, one verdict (see `scripts/analyze.py --gate` and the
 README "Static analysis" section):
 
 1. **Budget engine** (`budget.run_budgets`) — lowers registered
@@ -53,6 +53,15 @@ README "Static analysis" section):
    bit-exact results once faults clear (serve traffic, fault-recovered
    SpGEMM, resumed MCL), and vacuity floors on injected-fault/retry
    counts so the soak keeps exercising the paths it gates.
+9. **mesh-observatory budget** (`meshbudget.run_mesh`) — committed
+   communication invariants over the bench `mesh_summary` blocks
+   (`budgets/mesh.json`): per-device load/wall skew ceilings (with the
+   straggler named), a floor on the ledger-wall fraction carrying
+   per-device attribution, per-axis measured ICI byte ceilings, and a
+   band on the predicted-vs-measured drift ratio per ledger name — on
+   emulated meshes measurement equals the registered descriptors by
+   construction, so drift leaving the band means the analytic cost
+   model rotted, not the wire.
 
 All passes are trace/AST/JSON only — nothing here compiles or
 executes device code — and every finding carries `file:line`, a rule
@@ -107,8 +116,13 @@ def run_chaos(**kw):
     return chaosbudget.run_chaos(**kw)
 
 
+def run_mesh(**kw):
+    from combblas_tpu.analysis import meshbudget
+    return meshbudget.run_mesh(**kw)
+
+
 def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
-                    "mem", "trace", "chaos")) -> list[Finding]:
+                    "mem", "trace", "chaos", "mesh")) -> list[Finding]:
     """Run the selected passes; returns all unsuppressed findings
     (empty = gate passes)."""
     out: list[Finding] = []
@@ -128,4 +142,6 @@ def run_all(passes=("budgets", "retrace", "locks", "obs", "perf",
         out += run_tracehazard()
     if "chaos" in passes:
         out += run_chaos()
+    if "mesh" in passes:
+        out += run_mesh()
     return out

@@ -91,6 +91,18 @@ CHAOS_STALE = "chaos-stale-artifact"     # chaos budget names an
 #                                          artifact/summary field that
 #                                          no longer exists
 
+# mesh-observatory budget over bench mesh_summary blocks (pass 9)
+MESH_SKEW = "mesh-skew-budget"           # per-device load/wall skew (or
+#                                          attribution coverage) beyond
+#                                          the committed ceiling
+MESH_BYTES = "mesh-bytes-budget"         # measured per-axis ICI bytes
+#                                          over the committed ceiling
+MESH_DRIFT = "mesh-ici-drift"            # measured/predicted collective
+#                                          bytes left the committed band
+MESH_STALE = "mesh-stale-artifact"       # mesh budget names an artifact
+#                                          / ledger name / axis / metric
+#                                          that no longer exists
+
 # memory-budget gate over bench memory_summary blocks (pass 6)
 MEM_TEMP = "mem-temp-ceiling"            # per-executable temp bytes over
 #                                          the committed ceiling
@@ -115,6 +127,7 @@ ALL_RULES = (
     COLLECTIVE_TRANSPOSE, TRACE_STALE,
     CHAOS_UNRESOLVED, CHAOS_SHED, CHAOS_BIT_EXACT, CHAOS_RECOVERY,
     CHAOS_STALE,
+    MESH_SKEW, MESH_BYTES, MESH_DRIFT, MESH_STALE,
 )
 
 

@@ -1,0 +1,197 @@
+"""Pass 9 — mesh-observatory budget over committed bench artifacts.
+
+`scripts/multichip_bench.py` runs the multi-device drivers on an
+emulated (or real) mesh and commits a `mesh_summary` block — the
+observatory's (`obs.meshobs`) view of that run: measured exchanged
+bytes per (ledger name, collective, axis), the predicted-vs-measured
+ICI drift join against the roofline cost model, per-device load skew,
+and the fraction of ledger wall carrying per-device attribution. This
+pass holds that block against `analysis/budgets/mesh.json`, committing
+the communication story the same way pass 4 commits attribution
+coverage and pass 6 commits the memory story:
+
+* **skew ceilings** — per-device load (nnz, flops) and wall skew,
+  expressed as max-over-mean per metric, must stay under a committed
+  ceiling. A silent straggler is exactly what per-device attribution
+  exists to surface; the budget makes growth a finding, with the
+  straggler device named in the message.
+* **attribution floor** — the fraction of the dispatch-ledger wall
+  attributed to per-device load rows must stay above a floor.
+  Attribution that silently decays back to a blind aggregate defeats
+  the observatory.
+* **per-axis byte budgets** — measured bytes exchanged along each mesh
+  axis ("r", "c", "l", "rc") per run must stay under a committed
+  ceiling. A collective added to a hot loop shows up here before it
+  shows up on a wall clock.
+* **drift band** — measured/predicted bytes per ledger name must stay
+  inside a committed band. On emulated meshes the measurement equals
+  the registered descriptors by construction, so drift catches
+  *model* rot: a planner whose analytic cbytes stopped matching what
+  its kernel actually exchanges. bfs.*/cc.* names (while_loop drivers
+  with data-dependent trip counts) are deliberately not banded.
+* **staleness** — a budget naming an artifact, ledger name, axis, or
+  skew metric that no longer exists is flagged rather than silently
+  vacuous.
+
+Budget JSON shape (one file may pin several artifacts)::
+
+    {"artifacts": [{
+        "artifact": "MULTICHIP_r*.json",  # repo-root relative; globs
+                                          # pick newest by mtime
+        "driver": "multichip",
+        "require_mesh_summary": true,
+        "attribution_frac_min": 0.9,
+        "skew_max": {"nnz": 3.0, "wall": 4.0},
+        "axis_bytes_max": {"r": 4.0e6, "c": 4.0e6},
+        "drift_band": {"spgemm.summa": [0.95, 1.05]},
+        "allow": []                       # waived rule ids
+    }]}
+
+All checks are pure JSON reads — nothing here compiles or runs device
+code. A numeric check whose `mesh_summary` field is absent flags
+STALE (shape drift), never passes silently.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+from combblas_tpu.analysis import core
+from combblas_tpu.analysis.core import Finding
+from combblas_tpu.analysis.obsbudget import (
+    _line_of, _load_artifact, _resolve_artifact,
+)
+
+BUDGET_DIR = pathlib.Path(__file__).parent / "budgets"
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def check_artifact(ent: dict, budget_text: str, budget_path: str,
+                   root=None) -> list[Finding]:
+    """All findings for one budget entry (the unit the self-test
+    fixtures drive)."""
+    allow = set(ent.get("allow", []))
+    name = ent["artifact"]
+    driver = ent.get("driver", name)
+    findings: list[Finding] = []
+
+    def add(rule, key, msg):
+        if rule not in allow:
+            findings.append(Finding(
+                rule, budget_path, _line_of(budget_text, name, key),
+                msg, entry=driver))
+
+    path = _resolve_artifact(name, pathlib.Path(root or REPO_ROOT))
+    if path is None:
+        add(core.MESH_STALE, "artifact",
+            f"artifact {name!r} not found — run "
+            "scripts/multichip_bench.py to generate it, or drop the "
+            "stale budget entry")
+        return findings
+    try:
+        art = _load_artifact(path)
+    except ValueError as e:
+        add(core.MESH_STALE, "artifact", f"artifact unreadable: {e}")
+        return findings
+    ms = art.get("mesh_summary")
+    if not isinstance(ms, dict):
+        if ent.get("require_mesh_summary"):
+            add(core.MESH_STALE, "require_mesh_summary",
+                f"{path.name}: no mesh_summary block — the artifact "
+                "predates the mesh observatory (rerun "
+                "scripts/multichip_bench.py)")
+        return findings
+
+    floor = ent.get("attribution_frac_min")
+    if floor is not None:
+        v = ms.get("attribution_frac")
+        if v is None:
+            add(core.MESH_STALE, "attribution_frac_min",
+                f"{path.name}: mesh_summary has no attribution_frac "
+                "field — the artifact shape drifted from the budget")
+        elif float(v) < float(floor):
+            add(core.MESH_SKEW, "attribution_frac_min",
+                f"{path.name}: only {float(v):.1%} of the dispatch "
+                f"ledger wall carries per-device attribution (floor "
+                f"{float(floor):.1%}) — the observatory went blind on "
+                "part of the run")
+
+    # mesh_summary.skew is nested {ledger name: {metric: stats}} (with
+    # sampled device walls under the pseudo-name "device_wall", metric
+    # "wall"); the ceiling applies to the WORST name per metric.
+    worst: dict = {}
+    for nm, metrics in (ms.get("skew") or {}).items():
+        if not isinstance(metrics, dict):
+            continue
+        for metric, row in metrics.items():
+            if not isinstance(row, dict) or "max_over_mean" not in row:
+                continue
+            v = float(row["max_over_mean"])
+            if metric not in worst or v > worst[metric][0]:
+                worst[metric] = (v, f"{nm}:{row.get('straggler', '?')}")
+    for metric, ceil in sorted((ent.get("skew_max") or {}).items()):
+        if metric not in worst:
+            add(core.MESH_STALE, "skew_max",
+                f"{path.name}: mesh_summary.skew has no {metric!r} "
+                "metric under any ledger name — the budget names a "
+                "load metric the run no longer records")
+            continue
+        v, who = worst[metric]
+        if v > float(ceil):
+            add(core.MESH_SKEW, "skew_max",
+                f"{path.name}: per-device {metric} skew {v:.2f}x "
+                f"(max/mean) exceeds the committed ceiling "
+                f"{float(ceil):.2f}x — straggler {who}")
+
+    axis_bytes = ms.get("bytes_by_axis") or {}
+    for axis, ceil in sorted((ent.get("axis_bytes_max") or {}).items()):
+        if axis not in axis_bytes:
+            add(core.MESH_STALE, "axis_bytes_max",
+                f"{path.name}: mesh_summary.bytes_by_axis has no "
+                f"{axis!r} axis — the budget names a mesh axis the "
+                "run no longer exchanges on")
+            continue
+        v = float(axis_bytes[axis])
+        if v > float(ceil):
+            add(core.MESH_BYTES, "axis_bytes_max",
+                f"{path.name}: {v:.3g} measured bytes on mesh axis "
+                f"{axis!r} exceed the committed ceiling "
+                f"{float(ceil):.3g} — a collective grew (or joined a "
+                "hot loop) since the budget was set")
+
+    drift = ms.get("drift") or {}
+    for dn, band in sorted((ent.get("drift_band") or {}).items()):
+        lo, hi = float(band[0]), float(band[1])
+        v = drift.get(dn)
+        if v is None:
+            add(core.MESH_STALE, "drift_band",
+                f"{path.name}: no measured/predicted drift for "
+                f"{dn!r} — the ledger name is gone, was never "
+                "dispatched, or lost its cost-model prediction")
+            continue
+        v = float(v)
+        if not (lo <= v <= hi):
+            add(core.MESH_DRIFT, "drift_band",
+                f"{path.name}: {dn} measured/predicted ICI drift "
+                f"{v:.3f} outside the committed band [{lo}, {hi}] — "
+                "the analytic cost model no longer matches what the "
+                "kernel exchanges")
+    return findings
+
+
+def run_mesh(files=None, root=None) -> list[Finding]:
+    """Run the mesh-observatory budget pass over the committed budgets
+    (or an explicit fixture list); returns unsuppressed findings."""
+    paths = ([pathlib.Path(f) for f in files] if files is not None
+             else sorted(BUDGET_DIR.glob("mesh*.json")))
+    findings: list[Finding] = []
+    for p in paths:
+        text = p.read_text()
+        data = json.loads(text)
+        for ent in data.get("artifacts", []):
+            if "artifact" not in ent:
+                raise ValueError(f"{p}: mesh budget entry without "
+                                 "'artifact'")
+            findings += check_artifact(ent, text, str(p), root=root)
+    return findings

@@ -185,6 +185,11 @@ def plan_bfs(a: dm.DistSpMat, route: bool | str = False,
     # the nnz-proportional roofline costs of every bfs.*/spmv.* ledger
     # name so traversal dispatch walls grade against expected work
     obs.costmodel.annotate_matrix(a)
+    if not isinstance(a.nnz, jax.core.Tracer):  # mesh obs: eager plans only
+        annz = np.asarray(a.nnz)  # analysis: allow(sync-in-async) plan-time, once per matrix
+        for nm in ("bfs.bits_mesh", "bfs.batch_bits_mesh"):
+            obs.meshobs.register_device_loads(nm, nnz=annz)
+        _register_bits_mesh_collectives(a, "bfs.bits_mesh", 1)
     plan = _plan_bfs_core(a)
     if not route:
         return plan
@@ -1335,6 +1340,35 @@ def bfs_bits_mesh(a: dm.DistSpMat, root, plan: BfsPlan) -> dv.DistVec:
 bfs_bits_mesh = obs.instrument(bfs_bits_mesh, "bfs.bits_mesh")
 
 
+def _register_bits_mesh_collectives(a: dm.DistSpMat, name: str,
+                                    w: int) -> None:
+    """Register one LEVEL's collective descriptors for the bits-mesh
+    BFS drivers with the mesh observatory.  The wave loop runs a
+    data-dependent number of levels inside ``lax.while_loop``, so a
+    static per-dispatch byte total is unknowable at plan time; by
+    convention the registered set describes ONE level (plus the single
+    post-loop parents reduction) and budgets/mesh.json does not band
+    the drift ratio for bfs.* names.  ``w`` is the lane count (roots
+    per batch; 1 for the single-root driver)."""
+    nwv = -(-a.tile_m // 32)  # vertex-bit words per block
+    pc = a.grid.pc
+    both = ROW_AXIS + COL_AXIS
+    obs.meshobs.register_collectives(name, (
+        # transpose-route the new-frontier vertex words
+        dict(collective="ppermute", axis=both, dtype="uint32",
+             shape=(nwv, w), rung=0, bytes=4 * nwv * w),
+        # gather row-reached words across the process column
+        dict(collective="all_gather", axis=COL_AXIS, dtype="uint32",
+             shape=(pc, nwv, w), rung=1, bytes=(pc - 1) * 4 * nwv * w),
+        # frontier-empty vote
+        dict(collective="pmax", axis=both, dtype="int32",
+             shape=(w,), rung=2, bytes=4 * w),
+        # post-loop parents reduction (once per dispatch, not per level)
+        dict(collective="pmax", axis=COL_AXIS, dtype="int32",
+             shape=(a.tile_m, w), rung=3, bytes=4 * a.tile_m * w),
+    ))
+
+
 def bfs_batch_bits_mesh(a: dm.DistSpMat, roots, max_levels=None,
                         plan: BfsPlan | None = None):
     """Batched packed-bit BFS on a multi-tile routed mesh: the
@@ -1370,6 +1404,8 @@ def bfs_batch_bits_mesh(a: dm.DistSpMat, roots, max_levels=None,
     else:
         ml = jnp.asarray(max_levels, jnp.int32)
         ml = jnp.where(ml <= 0, jnp.int32(_SAT), ml)
+    _register_bits_mesh_collectives(a, "bfs.batch_bits_mesh",
+                                    int(roots_np.size))
     return _bfs_batch_bits_mesh_core(a, plan, roots32, ml)
 
 

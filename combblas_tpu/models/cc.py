@@ -76,8 +76,59 @@ def fastsv(a: dm.DistSpMat, max_iters: int = 100, *,
         return _fastsv_checkpointed(a, max_iters, checkpoint_path,
                                     int(checkpoint_every), resume)
     if a.grid.pr == a.grid.pc and a.grid.pr > 1 and a.tile_m == a.tile_n:
+        if not isinstance(a.nnz, jax.core.Tracer):  # eager dispatches only
+            _register_fastsv_collectives(a)
         return _fastsv_sharded(a, max_iters=max_iters)
     return _fastsv_replicated(a, max_iters=max_iters)
+
+
+def _register_fastsv_collectives(a: dm.DistSpMat) -> None:
+    """Register one ITERATION's collective descriptors for the sharded
+    FastSV kernel with the mesh observatory.  The fixpoint loop runs a
+    data-dependent number of iterations inside ``lax.while_loop``, so
+    (like the bits-mesh BFS drivers) the registered set describes ONE
+    body iteration and budgets/mesh.json does not band the drift ratio
+    for cc.* names.  Per-device all_to_all payload = the (q-1)/q
+    off-device fraction of the (q, blk) bucket matrix."""
+    q = a.grid.pr
+    blk = -(-a.tile_m // q)
+    both = ROW_AXIS + COL_AXIS
+    a2a = (q - 1) * 4 * blk
+    descs = (
+        # min_neighbor: transpose + column gather of the gf pieces
+        dict(collective="ppermute", axis=both, dtype="int32",
+             shape=(blk,), rung=0, bytes=4 * blk),
+        dict(collective="all_gather", axis=ROW_AXIS, dtype="int32",
+             shape=(q, blk), rung=1, bytes=a2a),
+        dict(collective="pmin", axis=COL_AXIS, dtype="int32",
+             shape=(a.tile_m,), rung=2, bytes=4 * a.tile_m),
+        # stochastic hooking: request routing + mesh-row reduce
+        dict(collective="all_to_all", axis=ROW_AXIS, dtype="int32",
+             shape=(q, blk), rung=3, bytes=a2a),
+        dict(collective="all_to_all", axis=ROW_AXIS, dtype="int32",
+             shape=(q, blk), rung=4, bytes=a2a),
+        dict(collective="pmin", axis=COL_AXIS, dtype="int32",
+             shape=(a.tile_m,), rung=5, bytes=4 * a.tile_m),
+        # pointer jumping: row slice + query/response routing
+        dict(collective="all_gather", axis=COL_AXIS, dtype="int32",
+             shape=(q, blk), rung=6, bytes=a2a),
+        dict(collective="all_to_all", axis=ROW_AXIS, dtype="int32",
+             shape=(q, blk), rung=7, bytes=a2a),
+        dict(collective="all_to_all", axis=ROW_AXIS, dtype="int32",
+             shape=(q, blk), rung=8, bytes=a2a),
+        # convergence vote
+        dict(collective="pmax", axis=both, dtype="int32",
+             shape=(), rung=9, bytes=4),
+    )
+    obs.meshobs.register_collectives("cc.fastsv_sharded", descs)
+    # predicted ICI bytes for ONE body iteration, so the drift join is
+    # non-null for cc.* too; the measured/predicted ratio then counts
+    # fixpoint iterations (which is why budgets do not band it)
+    obs.costmodel.annotate("cc.fastsv_sharded",
+                           cbytes=float(sum(d["bytes"] for d in descs)),
+                           calls=1)
+    annz = np.asarray(a.nnz)  # analysis: allow(sync-in-async) plan-time, once per driver call
+    obs.meshobs.register_device_loads("cc.fastsv_sharded", nnz=annz)
 
 
 def _replicated_fns(a: dm.DistSpMat, max_iters: int):

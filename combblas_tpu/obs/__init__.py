@@ -21,7 +21,12 @@ accumulators. This subsystem supersedes them:
   gated by analysis pass 6;
 * `obs.regress` — canonical bench trajectory (BENCH_TRAJECTORY.json)
   normalizers and the noise-banded regression detector behind
-  `scripts/bench_registry.py` and analysis pass 5.
+  `scripts/bench_registry.py` and analysis pass 5;
+* `obs.meshobs` — mesh observatory: static per-dispatch collective
+  descriptors registered at plan time, measured exchanged bytes per
+  (name, collective, axis) accumulated at dispatch, the
+  predicted-vs-measured ICI drift join, and per-device load/skew
+  attribution — gated by analysis pass 9.
 
 Everything is gated on ONE process-wide flag (`set_enabled`, the same
 contract as the old `timing._ENABLED`): disabled call sites cost one
@@ -40,8 +45,8 @@ Quick start::
 """
 
 from combblas_tpu.obs import (
-    costmodel, export, httpd, ledger, memledger, metrics, regress,
-    timeline, trace,
+    costmodel, export, httpd, ledger, memledger, meshobs, metrics,
+    regress, timeline, trace,
 )
 from combblas_tpu.obs.trace import (
     CATEGORIES, TRACER, Tracer, current_path, enabled, get_trace_id,

@@ -58,12 +58,16 @@ def dispatch_summary(k: int = 10, ledger=None) -> dict:
     aggregate `efficiency` verdict {attributable_frac, eff,
     bound_wall_s, backend} that `obs.regress` folds into the bench
     trajectory. {top: [...], dispatches, readbacks, compiles,
-    recorded, dropped, efficiency, memory} — `memory` is the compact
-    capacity verdict (peak resident, census coverage, headroom); the
-    full census + donation audit lives in `memory_summary`."""
+    recorded, dropped, efficiency, memory, mesh} — `memory` is the
+    compact capacity verdict (peak resident, census coverage,
+    headroom); the full census + donation audit lives in
+    `memory_summary`. `mesh` is the mesh observatory's compact verdict
+    (per-axis measured bytes, the drift table, attribution fraction);
+    the full per-name block lives in `obs.meshobs.mesh_summary`."""
     from combblas_tpu.obs import costmodel as _costmodel
     from combblas_tpu.obs import ledger as _ledger
     from combblas_tpu.obs import memledger as _memledger
+    from combblas_tpu.obs import meshobs as _meshobs
     led = ledger if ledger is not None else _ledger.LEDGER
     recs = led.snapshot()
     all_rows = _ledger.top_k(1 << 20, by="wall", records=recs,
@@ -79,6 +83,13 @@ def dispatch_summary(k: int = 10, ledger=None) -> dict:
         "memory": {
             **_memledger.headroom(),
             "census_coverage": _memledger.census_coverage(records=recs),
+        },
+        "mesh": {
+            "bytes_by_axis": _meshobs.bytes_by_axis(),
+            "drift": _meshobs.drift_table(),
+            "attribution_frac": round(
+                _meshobs.attribution_fraction(rows=all_rows), 4),
+            "registered_names": sorted(_meshobs.descriptors()),
         },
     }
 
@@ -245,7 +256,8 @@ def read_jsonl_metrics(path) -> dict | None:
 # ---------------------------------------------------------------------------
 
 def chrome_trace(path, tracer: Tracer | None = None,
-                 include_ledger: bool = True) -> int:
+                 include_ledger: bool = True,
+                 include_mesh: bool = True) -> int:
     """Emit complete ("ph": "X") events, microsecond timestamps
     rebased to the earliest span. Category and attrs land in `args`;
     `cat` enables Perfetto's category filter.
@@ -254,7 +266,16 @@ def chrome_trace(path, tracer: Tracer | None = None,
     `pid=1` "dispatch" track, and every record carrying a trace id
     additionally emits async FLOW events ("b"/"e" with `id` = the
     trace id) so one request's dispatches link across threads in
-    Perfetto's flow view."""
+    Perfetto's flow view.
+
+    `include_mesh` adds the mesh observatory's per-device view on
+    `pid=2`: one track per device label with registered loads
+    (`obs.meshobs.register_device_loads`), an X event per dispatch of
+    every device-attributed executable, and per-rung collective FLOW
+    events tying a broadcast's source track to its destination —
+    descriptors naming foreign or missing device ids still render (a
+    synthetic track id is minted), they just don't line up with a
+    load-attributed track."""
     recs = _records(tracer)
     led_recs = []
     if include_ledger:
@@ -297,9 +318,81 @@ def chrome_trace(path, tracer: Tracer | None = None,
             events.append({**base, "ph": "e", "id": fid,
                            "cat": "request",
                            "ts": (r.t0 + r.wall_s - t_base) * 1e6})
+    if include_mesh and led_recs:
+        events.extend(_mesh_events(led_recs, t_base))
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)
+
+
+def _mesh_events(led_recs, t_base: float) -> list:
+    """Per-device Chrome-trace tracks (pid=2) from the mesh
+    observatory: thread-name metadata per known device label, one X
+    event per (device-attributed dispatch, device) carrying the static
+    loads, and per-rung collective flow events linking a descriptor's
+    `src` track to its `dst` track. Device ids outside the load
+    registry (foreign) or absent (missing) get a synthetic hashed
+    track id — never a crash."""
+    from combblas_tpu.obs import meshobs as _meshobs
+    loads = _meshobs.device_loads()
+    descs = _meshobs.descriptors()
+    known = sorted({dev for row in loads.values()
+                    for grid in row.values() for dev in grid})
+    tid_of = {dev: i for i, dev in enumerate(known)}
+    # missing src/dst: a dedicated sentinel track one past the foreign
+    # hash range (1024..1024+0x7FFF), never a real device's track
+    none_tid = 1024 + 0x8000
+
+    def dev_tid(label):
+        if label is None:
+            return none_tid
+        t = tid_of.get(label)
+        # foreign id: a stable synthetic track clear of the real ones
+        return t if t is not None else 1024 + (hash(label) & 0x7FFF)
+
+    events = [{"ph": "M", "pid": 2, "name": "process_name",
+               "args": {"name": "mesh devices"}},
+              {"ph": "M", "pid": 2, "tid": none_tid,
+               "name": "thread_name", "args": {"name": "<no device>"}}]
+    for dev, t in tid_of.items():
+        events.append({"ph": "M", "pid": 2, "tid": t,
+                       "name": "thread_name", "args": {"name": dev}})
+    for r in led_recs:
+        if r.kind != "dispatch":
+            continue
+        row = loads.get(r.name)
+        if row:
+            per_dev: dict = {}
+            for metric, grid in row.items():
+                for dev, v in grid.items():
+                    per_dev.setdefault(dev, {})[metric] = v
+            for dev, metrics in per_dev.items():
+                events.append({
+                    "name": r.name, "cat": "mesh_device", "ph": "X",
+                    "ts": (r.t0 - t_base) * 1e6,
+                    "dur": max(r.wall_s, 1e-6) * 1e6,
+                    "pid": 2, "tid": dev_tid(dev),
+                    "args": {"device": dev, "seq": r.seq, **metrics},
+                })
+        for d in descs.get(r.name, ()):
+            fid = (r.seq * 131 + d["rung"]) & 0x7FFFFFFF
+            base = {
+                "name": f"{r.name}/{d['collective']}@{d['axis']}",
+                "cat": "collective", "pid": 2, "id": fid,
+                "args": {"seq": r.seq, "rung": d["rung"],
+                         "bytes": d["bytes"], "axis": d["axis"],
+                         "dtype": d["dtype"],
+                         "shape": list(d["shape"]),
+                         "src": d.get("src"), "dst": d.get("dst")},
+            }
+            events.append({**base, "ph": "b",
+                           "tid": dev_tid(d.get("src")),
+                           "ts": (r.t0 - t_base) * 1e6})
+            events.append({**base, "ph": "e",
+                           "tid": dev_tid(d.get("dst")),
+                           "ts": (r.t0 + max(r.wall_s, 1e-6)
+                                  - t_base) * 1e6})
+    return events
 
 
 # ---------------------------------------------------------------------------

@@ -111,6 +111,27 @@ def prometheus_text(snapshot: dict | None = None) -> str:
     return "\n".join(out) + "\n"
 
 
+def _unescape_label(v: str) -> str:
+    """Single-pass left-to-right label-value unescape (inverse of
+    `_esc`). Sequential str.replace passes are ORDER-BUGGY here: a
+    literal backslash followed by 'n' renders as '\\\\n' and a later
+    '\\n'-replace pass would wrongly decode the already-unescaped
+    backslash + 'n' into a newline."""
+    out = []
+    i = 0
+    n = len(v)
+    while i < n:
+        ch = v[i]
+        if ch == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            out.append("\n" if nxt == "n" else nxt)
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
 _SAMPLE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
     r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
@@ -154,9 +175,7 @@ def parse_prometheus(text: str) -> dict:
             raise ValueError(f"line {lineno}: sample {name!r} has no "
                              f"# TYPE declaration")
         raw = m.group("labels") or ""
-        labels = tuple(sorted((k, v.replace('\\"', '"')
-                               .replace("\\n", "\n")
-                               .replace("\\\\", "\\"))
+        labels = tuple(sorted((k, _unescape_label(v))
                               for k, v in _LABEL_PAIR.findall(raw)))
         consumed = sum(len(k) + len(v) + 4 for k, v in
                        _LABEL_PAIR.findall(raw))
@@ -207,6 +226,10 @@ def refresh_obs_gauges() -> None:
         _metrics.gauge("obs.mem_headroom_frac",
                        "1 - worst(peak, largest footprint) / hbm_bytes"
                        ).set(hr["headroom_frac"])
+    # mesh observatory: measured collective bytes per (name, axis),
+    # per-name drift ratios, load-skew, attribution coverage
+    from combblas_tpu.obs import meshobs as _meshobs
+    _meshobs.refresh_gauges()
 
 
 def varz_snapshot(extra=None, top_k: int = 10) -> dict:
@@ -215,10 +238,13 @@ def varz_snapshot(extra=None, top_k: int = 10) -> dict:
     capacity block (headroom, census stats, top footprints — NOT the
     donation audit, which re-walks the census per declared name and
     stays off the scrape path; fetch it via `export.memory_summary`)
+    + the mesh observatory's full block (measured collective bytes per
+    (name, collective, axis), drift table, skew gauges) under "mesh"
     + whatever the hosting service adds via `extra()` (e.g.
     GraphService stats/plan-cache hit rates)."""
     from combblas_tpu.obs import costmodel as _costmodel
     from combblas_tpu.obs import memledger as _memledger
+    from combblas_tpu.obs import meshobs as _meshobs
     refresh_obs_gauges()
     led = _ledger.LEDGER
     out = {
@@ -242,6 +268,7 @@ def varz_snapshot(extra=None, top_k: int = 10) -> dict:
             "watermark_samples": _memledger.watermark_samples(),
             "top_footprints": _memledger.top_footprints(top_k),
         },
+        "mesh": _meshobs.mesh_summary(),
     }
     if extra is not None:
         try:

@@ -68,6 +68,22 @@ def set_fault_hook(hook) -> None:
     _FAULT_HOOK = hook
 
 
+#: mesh-observatory sink (obs.meshobs) — called with the executable
+#: name after every RECORDED dispatch so the collective-traffic ledger
+#: accumulates the name's registered per-dispatch byte descriptors.
+#: Same disarmed-cost contract as the fault hook: one module-global
+#: load + `is None` check; never fires when the ledger is off.
+_DISPATCH_SINK = None
+
+
+def set_dispatch_sink(sink) -> None:
+    """Install/remove the per-dispatch sink (a callable taking the
+    ledger name; see `combblas_tpu.obs.meshobs`). The sink runs after
+    the record is written, only for records that actually land."""
+    global _DISPATCH_SINK
+    _DISPATCH_SINK = sink
+
+
 def set_enabled(on: bool) -> None:
     """Arm/disarm the ledger independently of span tracing (spans may
     stay on while the per-dispatch recorder is off, e.g. long soaks)."""
@@ -204,6 +220,9 @@ def record(name: str, kind: str, t0: float, wall_s: float,
         seq, name, kind, t0, wall_s, tuple(arg_shapes), arg_bytes,
         out_bytes, compiled, _trace.current_path(),
         threading.get_ident(), _trace.get_trace_id(), t_enq))
+    sink = _DISPATCH_SINK
+    if sink is not None and kind == "dispatch":
+        sink(name)
 
 
 @contextlib.contextmanager
@@ -361,6 +380,9 @@ def instrument(fn, name: str, *, kind: str = "dispatch",
             seq, name, kind, t0, wall, shapes, abytes, obytes, compiled,
             _trace.current_path(), threading.get_ident(),
             _trace.get_trace_id(), mem_bytes=mem))
+        sink = _DISPATCH_SINK
+        if sink is not None and kind == "dispatch":
+            sink(name)
         return out
 
     wrapper.__name__ = f"ledger[{name}]"
@@ -405,7 +427,9 @@ def top_k(k: int = 10, by: str = "wall", ledger: Ledger | None = None,
         row["temp_bytes"] = fp["temp_bytes"] if fp else None
     if join_costs:
         from combblas_tpu.obs import costmodel
+        from combblas_tpu.obs import meshobs
         costmodel.join_rows(rows)
+        meshobs.join_rows(rows)
     return rows
 
 
@@ -417,14 +441,16 @@ def format_table(k: int = 10, by: str = "wall",
     no annotation. The `memMB` column is the name's compile-time
     footprint ceiling (args+outputs+temps of its largest executable,
     from the memledger census); blank when no executable was
-    attributed (warm cache)."""
+    attributed (warm cache). The `drift` column is the mesh
+    observatory's measured/predicted ICI-byte ratio (obs.meshobs);
+    blank when the name registered no collective descriptors."""
     rows = top_k(k, by=by, ledger=ledger)
     led = ledger if ledger is not None else LEDGER
     out = [f"dispatch ledger: {led.total} records "
            f"({led.dropped} wrapped out), top {len(rows)} by {by}:"]
     out.append(f"  {'executable':40s} {'count':>7s} {'total_s':>10s} "
                f"{'mean_ms':>9s} {'compiles':>8s} {'eff':>8s} "
-               f"{'memMB':>8s}")
+               f"{'memMB':>8s} {'drift':>7s}")
     for r in rows:
         if r.get("eff") is not None:
             eff = f"{r['eff']:.3f}/{r['bound'][0]}"
@@ -434,9 +460,11 @@ def format_table(k: int = 10, by: str = "wall",
             eff = ""
         mem = (f"{r['mem_bytes'] / 1e6:8.1f}"
                if r.get("mem_bytes") is not None else f"{'':8s}")
+        dr = (f"{r['drift']:7.3f}"
+              if r.get("drift") is not None else f"{'':7s}")
         out.append(f"  {r['name'][:40]:40s} {r['count']:7d} "
                    f"{r['total_s']:10.4f} {r['mean_s'] * 1e3:9.3f} "
-                   f"{r['compiles']:8d} {eff:>8s} {mem}")
+                   f"{r['compiles']:8d} {eff:>8s} {mem} {dr}")
     return "\n".join(out)
 
 

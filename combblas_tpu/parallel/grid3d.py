@@ -29,6 +29,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from combblas_tpu import obs
 from combblas_tpu.ops import tile as tl
 from combblas_tpu.ops import tile_algebra as ta
 from combblas_tpu.ops.semiring import Semiring
@@ -229,6 +230,7 @@ def summa3d(sr: Semiring, a3: DistSpMat3D, b3: DistSpMat3D, *,
             ja, ib = lo // a3.tile_n, lo // b3.tile_m
             intervals.append((lo, hi, ja, lo - ja * a3.tile_n,
                               ib, lo - ib * b3.tile_m))
+    _register_summa3d_collectives(a3, b3, intervals, out_cap, out_dtype)
 
     def f(ar, ac, av, an, br, bc, bv, bn):
         my_r = lax.axis_index(ROW_AXIS)
@@ -271,6 +273,59 @@ def summa3d(sr: Semiring, a3: DistSpMat3D, b3: DistSpMat3D, *,
         check_vma=False,
     )(a3.rows, a3.cols, a3.vals, a3.nnz, b3.rows, b3.cols, b3.vals, b3.nnz)
     return cr, cc, cv, cn, tile_m, tile_nb
+
+
+def _register_summa3d_collectives(a3: DistSpMat3D, b3: DistSpMat3D,
+                                  intervals, out_cap: int,
+                                  out_dtype) -> None:
+    """Register summa3d's per-dispatch collective descriptors with the
+    mesh observatory and annotate the matching exact per-call ICI
+    prediction, so the drift gate pins measured/predicted at 1.0 by
+    construction on emulated meshes.  Per device: one dense-tile psum
+    per A/B broadcast rung (the per-layer SUMMA), then the fiber merge
+    as four all_gathers along the layer axis."""
+    grid3 = a3.grid
+    l = grid3.nlayers
+    descs = []
+    wire = 0
+    rung = 0
+    prev_ja = prev_ib = None
+    for (_lo, _hi, ja, _la, ib, _lb) in intervals:
+        if ja != prev_ja:
+            payload = spg._bcast_payload_bytes(a3.cap, a3.dtype)
+            descs.append(dict(collective="psum", axis=COL_AXIS,
+                              dtype=str(a3.dtype), shape=(a3.cap,),
+                              rung=rung, bytes=payload, src=f"l*r*c{ja}"))
+            wire += payload
+            prev_ja = ja
+            rung += 1
+        if ib != prev_ib:
+            payload = spg._bcast_payload_bytes(b3.cap, b3.dtype)
+            descs.append(dict(collective="psum", axis=ROW_AXIS,
+                              dtype=str(b3.dtype), shape=(b3.cap,),
+                              rung=rung, bytes=payload, src=f"l*r{ib}c*"))
+            wire += payload
+            prev_ib = ib
+            rung += 1
+    vb = np.dtype(out_dtype).itemsize
+    for field, b in (("rows", 4 * out_cap), ("cols", 4 * out_cap),
+                     ("vals", vb * out_cap), ("nnz", 4)):
+        payload = (l - 1) * b
+        descs.append(dict(collective="all_gather", axis=LAYER_AXIS,
+                          dtype="int32" if field != "vals"
+                          else str(np.dtype(out_dtype)),
+                          shape=(l, out_cap) if field != "nnz" else (l,),
+                          rung=rung, bytes=payload))
+        wire += payload
+        rung += 1
+    obs.meshobs.register_collectives("spgemm.summa3d", descs)
+    obs.costmodel.annotate("spgemm.summa3d", cbytes=wire, calls=1)
+    if not isinstance(a3.nnz, jax.core.Tracer):  # eager dispatches only
+        annz = np.asarray(a3.nnz)  # (l, pr, pc)
+        obs.meshobs.register_device_loads("spgemm.summa3d", nnz=annz)
+
+
+summa3d = obs.instrument(summa3d, "spgemm.summa3d", sync=True)
 
 
 def _result_to_2d(cr, cc, cv, cn, tile_m, tile_n, nrows, ncols,

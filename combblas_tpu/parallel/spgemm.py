@@ -188,6 +188,11 @@ def plan_spgemm(a: DistSpMat, b: DistSpMat) -> tuple[int, int]:
     total_f = int(tile_total.sum())
     obs.costmodel.annotate("spgemm.summa", flops=2.0 * total_f,
                            lbytes=24.0 * total_f)
+    # mesh observatory: the planner knows the EXACT per-tile load, so
+    # per-device attribution (skew gauges, per-device trace tracks) is
+    # free here — one registration per plan, replacing the last one
+    obs.meshobs.register_device_loads("spgemm.summa",
+                                      flops=2 * tile_total, nnz=annz)
     return max(stage_max, 1), max(out_cap, 1)
 
 
@@ -350,6 +355,8 @@ def _record_bcasts(a: DistSpMat, b: DistSpMat, plan: tuple) -> None:
     t0 = time.perf_counter()
     prev_ja = prev_ib = None
     wire = 0
+    rung = 0
+    descs = []
     for (lo, hi, ja, la, ib, lb), (avar, ak, bvar, bk) in zip(
             intervals, plan):
         if ja != prev_ja:
@@ -357,6 +364,10 @@ def _record_bcasts(a: DistSpMat, b: DistSpMat, plan: tuple) -> None:
             obs.ledger.record(f"spgemm.bcast/{avar}", "dispatch", t0, 0.0,
                               arg_bytes=payload)
             obs.costmodel.annotate(f"spgemm.bcast/{avar}", cbytes=payload)
+            descs.append(dict(collective="psum", axis=COL_AXIS,
+                              dtype=str(a.vals.dtype), shape=(ak,),
+                              rung=rung, bytes=payload, src=f"r0c{ja}"))
+            rung += 1
             wire += payload
             _M_BCAST.inc(kind=avar)
             prev_ja = ja
@@ -365,9 +376,21 @@ def _record_bcasts(a: DistSpMat, b: DistSpMat, plan: tuple) -> None:
             obs.ledger.record(f"spgemm.bcast/{bvar}", "dispatch", t0, 0.0,
                               arg_bytes=payload)
             obs.costmodel.annotate(f"spgemm.bcast/{bvar}", cbytes=payload)
+            descs.append(dict(collective="psum", axis=ROW_AXIS,
+                              dtype=str(b.vals.dtype), shape=(bk,),
+                              rung=rung, bytes=payload, src=f"r{ib}c0"))
+            rung += 1
             wire += payload
             _M_BCAST.inc(kind=bvar)
             prev_ib = ib
+    # mesh observatory: the same broadcast rungs, as static
+    # per-dispatch descriptors — the sink accumulates these bytes per
+    # (collective, axis) at every recorded summa dispatch, and the
+    # drift gate divides them by the cbytes annotation below (equal by
+    # construction, so spgemm.summa's drift pins 1.0 when plan and
+    # dispatch sequences agree). src names the representative source
+    # device of each broadcast group.
+    obs.meshobs.register_collectives("spgemm.summa", descs)
     # the collectives execute INSIDE the fused summa dispatch, so its
     # measured wall carries their wire time: credit the plan's total
     # exchange volume to spgemm.summa's cbytes (calls=0 — the summa
@@ -587,6 +610,20 @@ class CapLadder:
             self.rungs.append(rung)
         _M_LADDER.inc(kind="miss")
         return rung
+
+    def refit(self, x: int, floor: Optional[int] = None) -> Optional[int]:
+        """Smallest already-minted rung that holds ``x``, or None —
+        never mints. Opportunistic shrink sites (the async pipeline's
+        one-window-behind count polls) must use this instead of
+        ``fit``: their ``x`` is a RACY async readback that may or may
+        not be home, so minting there would make the compiled shape
+        set timing-dependent — exactly the recompile churn the ladder
+        exists to prevent. Reuse-or-skip keeps every shape a
+        deterministic plan-time rung."""
+        fl = self.floor if floor is None else floor
+        x = max(int(x), fl, 1)
+        held = [r for r in self.rungs if r >= x]
+        return min(held) if held else None
 
     def save(self, path: str) -> None:
         """Serialize the minted rungs to JSON: a later run (or process)
@@ -917,6 +954,13 @@ def plan_colwindows(a: DistSpMat, b: DistSpMat, *,
             variant=_propose_variant(density, mode, dense_thr, hash_thr),
             fmt=fmt, mode=mode, dense_thr=dense_thr, hash_thr=hash_thr,
             block_thr=block_thr, bm=bm, bn=bn))
+    # mesh observatory: the phased path runs on tile (0,0) — register
+    # the plan's exact window-flop total as that device's load so
+    # phased runs stay inside the attribution-coverage pin
+    obs.meshobs.register_device_loads(
+        "spgemm.colwindow",
+        flops={"r0c0": float(sum(2 * w.flops for w in windows))},
+        nnz={"r0c0": float(annz if same else annz + bnnz)})
     return windows
 
 
@@ -1468,9 +1512,13 @@ def _phased_1x1_run(sr: Semiring, a: DistSpMat, b: DistSpMat, *,
         return _windows_sync(sr, a, b, at, bt, windows, win_width,
                              b_struct, prune_hook, out_cap, cap_round,
                              fit, wrap, variants, a_dense, block_out)
+    # the async loop's count-poll shrinks take the non-minting lookup:
+    # a racy readback must never decide a fresh compile shape
+    refit = cap_ladder.refit if cap_ladder is not None else _bucket_fine
     return _windows_async(sr, a, b, at, bt, windows, win_width,
                           b_struct, prune_hook, out_cap, cap_round,
-                          fit, wrap, variants, a_dense, block_out)
+                          fit, wrap, variants, a_dense, block_out,
+                          refit=refit)
 
 
 def _windows_sync(sr, a, b, at, bt, windows, win_width, b_struct,
@@ -1616,8 +1664,11 @@ def _block_concat_out(block_parts, a, b):
 
 def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
                    prune_hook, out_cap, cap_round, fit, wrap,
-                   variants=None, a_dense=None, block_out=False):
+                   variants=None, a_dense=None, block_out=False,
+                   refit=None):
     """The async pipeline (default): see `_phased_1x1`'s docstring."""
+    if refit is None:
+        refit = fit
     hook_meta = (a.grid, a.nrows, b.ncols)
     if variants is None:
         variants = ["esc"] * len(windows)
@@ -1701,8 +1752,9 @@ def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
         item = dispatch_window(0, *windows[0])
         cp = item[1]
         pn = resolve_count(item)
-        if pn is not None and fit(pn, 128) < cp.cap:
-            cp = _shrink_tile(cp, new_cap=fit(pn, 128))
+        rf = refit(pn, 128) if pn is not None else None
+        if rf is not None and rf < cp.cap:
+            cp = _shrink_tile(cp, new_cap=rf)
         return wrap(cp)
 
     acc = None          # (rows, cols, vals) sentinel-padded, unsorted
@@ -1716,7 +1768,8 @@ def _windows_async(sr, a, b, at, bt, windows, win_width, b_struct,
         nonlocal acc, off_dev, nlive_ub
         wi, cp, nnz_ref, handle = item
         pn = resolve_count(item)
-        new_cap = min(fit(pn, 128), cp.cap) if pn is not None else cp.cap
+        rf = refit(pn, 128) if pn is not None else None
+        new_cap = min(rf, cp.cap) if rf is not None else cp.cap
         with obs.span("place", category="dispatch", w=wi):
             need_buf = nlive_ub + new_cap  # off_actual <= nlive_ub, so
             if acc is None:                # placement can never clamp

@@ -168,8 +168,34 @@ def annotate_costs(a: DistSpMat, calls: int = 1) -> None:
     ledger name for matrix ``a``. Plan-time hook (one host nnz sync):
     `plan_bfs`, serve's SpMV plan build, and `spmsv_timed` call it so
     the cost model can grade SpMV dispatch walls; hot jitted paths
-    never pay it."""
+    never pay it.
+
+    Also feeds the mesh observatory: the fan stages' collective
+    descriptors (fan-out ≅ AllGatherVector replicating the vector along
+    the column axis; fan-in = the monoid psum along the same axis),
+    with bytes matching the `_MATRIX_FAMILIES` cbytes model exactly so
+    the measured/predicted drift ratio pins 1.0 wherever plan and
+    dispatch agree — plus per-tile nnz as the per-device load grid."""
     _obs.costmodel.annotate_matrix(a, names=_SPMV_NAMES, calls=calls)
+    import numpy as np
+    nrows = int(a.nrows)
+    dt = str(a.vals.dtype)
+    esize = np.dtype(a.vals.dtype).itemsize
+    for name, coll in (("spmv.fanout", "all_gather"),
+                       ("spmv.fanin", "psum")):
+        if esize != 4:
+            # _MATRIX_FAMILIES prices the fan stages at 4 B/row; top
+            # up the prediction (calls already counted above) so
+            # descriptor bytes, dtype, and cbytes stay in agreement
+            # for wider vector dtypes and drift still pins 1.0
+            _obs.costmodel.annotate(
+                name, cbytes=(esize - 4) * nrows * calls, calls=0)
+        _obs.meshobs.register_collectives(name, [
+            dict(collective=coll, axis=COL_AXIS, dtype=dt,
+                 shape=(nrows,), rung=0, bytes=esize * nrows)])
+    annz = np.asarray(a.nnz)   # analysis: allow(sync-in-async) plan-time
+    for name in _SPMV_NAMES:
+        _obs.meshobs.register_device_loads(name, nnz=annz)
 
 
 def spmsv_timed(sr: Semiring, a: DistSpMat, y_prev: DistSpVec,

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Static-analysis gate: one command, eight passes, one verdict.
+"""Static-analysis gate: one command, nine passes, one verdict.
 
     PYTHONPATH=/root/repo python scripts/analyze.py --gate
 
@@ -30,6 +30,10 @@ code):
   chaos     chaos-recovery budget over the committed CHAOS_r*.json
             soak artifacts: zero unresolved handles, bounded shed,
             bit-exact recovery, vacuity floors (chaos.json)
+  mesh      mesh-observatory budget over bench mesh_summary blocks:
+            per-device skew ceilings, attribution floors, per-axis
+            measured ICI byte ceilings, predicted-vs-measured drift
+            bands (mesh.json)
 
 Exit status: 0 iff no unsuppressed finding (the CI gate contract —
 `pytest -m quick` runs the same passes via tests/test_analysis.py).
@@ -47,7 +51,7 @@ against the committed copy to flag waiver growth.
                   rule actually FIRES (exit 0 = the gate bites)
     --json        machine-readable findings on stdout
     --passes a,b  subset of budgets,retrace,locks,obs,perf,mem,trace,
-                  chaos (default: all)
+                  chaos,mesh (default: all)
     --entry NAME  restrict the budget pass to one registry entry
     --diff [REV]  fast iteration loop: run only the AST passes (locks,
                   trace) whole-tree and report findings in files
@@ -83,7 +87,7 @@ def _cpu_env():
 
 
 ALL_PASSES = ("budgets", "retrace", "locks", "obs", "perf", "mem",
-              "trace", "chaos")
+              "trace", "chaos", "mesh")
 
 
 def run_passes(passes, entry=None):
@@ -129,6 +133,10 @@ def run_passes(passes, entry=None):
         t0 = time.time()
         record("chaos", analysis.run_chaos())
         timings["chaos"] = time.time() - t0
+    if "mesh" in passes and entry is None:
+        t0 = time.time()
+        record("mesh", analysis.run_mesh())
+        timings["mesh"] = time.time() - t0
     return findings, timings, counts
 
 
@@ -476,6 +484,45 @@ def self_test() -> int:
     else:
         print("  [ok] bad_chaos_budget.json: missing artifact flagged")
 
+    # --- pass 9: mesh-observatory budget fixtures ---
+    from combblas_tpu.analysis import meshbudget
+
+    print("fixture: bad_mesh_budget.json")
+    fs = meshbudget.run_mesh(files=[fx / "bad_mesh_budget.json"],
+                             root=fx)
+    expect("mesh budget overshoot", {f.rule for f in fs},
+           core.MESH_SKEW, core.MESH_BYTES, core.MESH_DRIFT,
+           core.MESH_STALE)
+    # the waived entry must be suppressed: exactly TWO skew findings
+    # survive (nnz skew + attribution floor from the unwaived entry),
+    # not three
+    skews = [f for f in fs if f.rule == core.MESH_SKEW]
+    if len(skews) != 2:
+        failures.append(f"bad_mesh_budget.json: expected exactly 2 "
+                        f"surviving mesh-skew findings (nnz skew + "
+                        f"attribution floor; the waived entry "
+                        f"suppressed), got {len(skews)}")
+    else:
+        print("  [ok] bad_mesh_budget.json: allow-list honored")
+    # every stale arm must fire: missing skew metric, missing axis,
+    # and a drift name the artifact never measured
+    stales = [f for f in fs if f.rule == core.MESH_STALE]
+    if len(stales) != 3:
+        failures.append(f"bad_mesh_budget.json: expected 3 "
+                        f"mesh-stale-artifact findings (metric + axis "
+                        f"+ drift name), got {len(stales)}")
+    else:
+        print("  [ok] bad_mesh_budget.json: all stale arms fire")
+    # resolved against the repo root the fixture artifact is absent:
+    # the missing-artifact arm of mesh-stale-artifact must fire
+    missing = meshbudget.run_mesh(files=[fx / "bad_mesh_budget.json"])
+    if not any(f.rule == core.MESH_STALE and "not found" in f.message
+               for f in missing):
+        failures.append("bad_mesh_budget.json: missing artifact did "
+                        "not flag mesh-stale-artifact")
+    else:
+        print("  [ok] bad_mesh_budget.json: missing artifact flagged")
+
     if failures:
         print("\nSELF-TEST FAILED:")
         for f in failures:
@@ -498,7 +545,7 @@ def main() -> int:
     ap.add_argument("--passes",
                     default=",".join(ALL_PASSES),
                     help="comma list of budgets,retrace,locks,obs,"
-                         "perf,mem,trace,chaos")
+                         "perf,mem,trace,chaos,mesh")
     ap.add_argument("--entry", default=None,
                     help="restrict the budget pass to one entry point")
     ap.add_argument("--diff", nargs="?", const="HEAD", default=None,

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Multi-chip dry run + scale-out exchange bench -> MULTICHIP_r06.json.
+"""Multi-chip dry run + scale-out exchange bench -> MULTICHIP_r07.json.
 
 Promotes the driver's `dryrun_multichip` smoke into a real bench with
 three sections (``--kinds``, comma-separated, default all):
@@ -19,14 +19,18 @@ three sections (``--kinds``, comma-separated, default all):
            32-root `bfs_batch_bits` is per-root no slower than the
            dense-column `bfs_batch` on the same mesh.
 
-Everything runs under obs spans; the headline JSON embeds
-`obs.dispatch_summary()` plus the `spgemm.bcast/{dense,sparse}`
-ledger tallies. bench.py-style output: one JSON line per section,
-the LAST line is the headline dict (also written to ``--out``).
+Everything runs under obs spans; the headline JSON carries the full
+bench_registry schema — `obs.dispatch_summary()`, `unaccounted_s`,
+`memory_summary`, and the mesh observatory's `mesh_summary` (measured
+bytes per collective/axis, predicted-vs-measured ICI drift, per-device
+skew and attribution — the block analysis pass 9 gates) — plus the
+`spgemm.bcast/{dense,sparse}` ledger tallies. bench.py-style output:
+one JSON line per section, the LAST line is the headline dict (also
+written to ``--out``).
 
 Usage: multichip_bench.py [--devices 8] [--scale 12] [--bits-scale 12]
                           [--kinds dryrun,spgemm,bits] [--seed 7]
-                          [--out MULTICHIP_r06.json]
+                          [--out MULTICHIP_r07.json]
 """
 import argparse
 import json
@@ -303,7 +307,7 @@ def main():
         ap.error(f"unknown --kinds {sorted(bad)}; choose from {KINDS}")
     root_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if args.out is None:
-        args.out = os.path.join(root_dir, "MULTICHIP_r06.json")
+        args.out = os.path.join(root_dir, "MULTICHIP_r07.json")
 
     GE._force_cpu_backend(args.devices)
     from combblas_tpu import obs
@@ -322,17 +326,29 @@ def main():
         sections["bits"] = run_bits(args)
     summary = obs.dispatch_summary()
     memory = obs.memory_summary()
+    mesh = obs.meshobs.mesh_summary()
+    # one phase_breakdown snapshot feeds BOTH walls so the artifact is
+    # internally consistent: `wall_s` is the whole-run span total
+    # (compiles included) and `unaccounted_s` is its exact residual —
+    # unaccounted_s <= wall_s by construction. The per-section warm
+    # walls (spgemm.wall_auto_s etc.) stay the regression metrics.
+    phases = obs.export.phase_breakdown()
+    unaccounted = round(float(phases["unaccounted"]), 4)
+    wall = round(float(phases["total"]), 4)
     obs.set_enabled(False)
 
     headline = {
         "n_devices": args.devices, "rc": 0,
+        "wall_s": wall,
         "ok": all(s.get("ok", True) for s in sections.values())
               and sections.get("spgemm", {}).get("passes_2x", True)
               and sections.get("bits", {}).get("passes_no_worse", True),
         "kinds": list(kinds),
         **{k: v for k, v in sections.items()},
         "dispatch_summary": summary,
+        "unaccounted_s": unaccounted,
         "memory_summary": memory,
+        "mesh_summary": mesh,
         "roofline": summary.get("efficiency"),
         "note": "dryrun: full correctness sweep on the virtual mesh. "
                 "spgemm: per-round exchanged bytes of the hybrid "

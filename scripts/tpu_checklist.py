@@ -730,6 +730,105 @@ def run_mesh_check() -> bool:
     return ok
 
 
+def run_meshobs_check() -> bool:
+    """Step 0j: mesh-observatory smoke — an instrumented SUMMA on a
+    2x2 submesh must register collective descriptors at plan time,
+    accumulate measured exchanged bytes at dispatch, join them to a
+    cost-model prediction (drift ratio present; exactly 1.0 where the
+    planner annotates descriptor-equal cbytes), surface per-device
+    skew, and expose the whole block in the /varz `mesh` section.
+    Skips (OK) when fewer than 4 devices are attached."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from combblas_tpu import obs
+    from combblas_tpu.obs import meshobs
+    from combblas_tpu.ops import generate, semiring as S
+    from combblas_tpu.parallel import distmat as dm, spgemm as spg
+    from combblas_tpu.parallel.grid import ProcGrid
+
+    step("0j. mesh-observatory smoke (--meshobs)")
+    devs = jax.devices()
+    if len(devs) < 4:
+        print(f"SKIP: {len(devs)} device(s) attached, meshobs smoke "
+              "needs 4 (2x2)")
+        return True
+    ok = True
+    mesh = ProcGrid.make(2, 2, devs[:4])
+    n = 1 << 9
+    r, c = generate.rmat_edges(jax.random.key(5), 9, 8)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, mesh, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.costmodel.reset()
+    meshobs.reset()
+    obs.set_enabled(True)
+    srv = obs.serve_metrics(port=0)
+    try:
+        af = a.astype(jnp.float32)
+        cm = spg.spgemm(S.PLUS_TIMES_F32, af, af)
+        cm.vals.block_until_ready()
+        descs = meshobs.descriptors("spgemm.summa")
+        print(f"  spgemm.summa: {len(descs)} registered descriptor(s)")
+        if not descs:
+            print("FAIL: SUMMA plan registered no collective "
+                  "descriptors")
+            ok = False
+        meas = meshobs.measured("spgemm.summa")
+        total = sum(v["bytes"] for v in meas.values())
+        expect = (sum(d["bytes"] for d in descs)
+                  * meshobs.dispatches("spgemm.summa"))
+        print(f"  measured={total} bytes over {sorted(meas)} "
+              f"(descriptor total x dispatches = {expect})")
+        if total != expect or total == 0:
+            print("FAIL: measured bytes disagree with the registered "
+                  "descriptors")
+            ok = False
+        drift = meshobs.drift("spgemm.summa")
+        print(f"  drift(spgemm.summa) = {drift}")
+        if drift is None or not (0.5 <= drift <= 2.0):
+            print("FAIL: SUMMA drift missing or far from 1 — the "
+                  "plan-time prediction no longer joins")
+            ok = False
+        skew = meshobs.skew_summary().get("spgemm.summa", {})
+        if "nnz" not in skew:
+            print(f"FAIL: no per-device nnz skew for spgemm.summa "
+                  f"(skew={skew})")
+            ok = False
+        else:
+            print(f"  nnz skew: {skew['nnz']['max_over_mean']:.2f}x "
+                  f"(straggler {skew['nnz']['straggler']})")
+        with urllib.request.urlopen(srv.url + "/varz", timeout=10) as f:
+            varz = json.loads(f.read().decode())
+        vm = varz.get("mesh", {})
+        if "spgemm.summa" not in vm.get("names", {}):
+            print(f"FAIL: /varz mesh block missing spgemm.summa "
+                  f"(names: {sorted(vm.get('names', {}))})")
+            ok = False
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as f:
+            metrics = obs.parse_prometheus(f.read().decode())
+        if not any(nm.startswith("mesh_") for nm, _ in metrics):
+            print("FAIL: no mesh_* gauges on /metrics")
+            ok = False
+        print("mesh observatory:", "OK" if ok else "FAILED")
+    except Exception:
+        traceback.print_exc()
+        ok = False
+    finally:
+        srv.stop()
+        obs.set_enabled(False)
+        obs.reset()
+        obs.ledger.LEDGER.reset()
+        obs.costmodel.reset()
+        meshobs.reset()
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser(
         description="on-chip validation + perf checklist")
@@ -768,6 +867,12 @@ def main():
                          "matches the dense batch, hybrid SUMMA "
                          "exchange bit-exact vs forced dense (skips "
                          "when <4 devices)")
+    ap.add_argument("--meshobs", action="store_true",
+                    help="mesh-observatory smoke on a 2x2 submesh: "
+                         "SUMMA registers collective descriptors, "
+                         "measured bytes join to the cost model "
+                         "(drift ~1), per-device skew + /varz mesh "
+                         "block present (skips when <4 devices)")
     ap.add_argument("--mem", action="store_true",
                     help="memory-ledger smoke: tiny phased A*A with "
                          "the footprint census on; census coverage "
@@ -806,6 +911,8 @@ def main():
     if args.block and not run_block_check(grid):
         sys.exit(1)
     if args.mesh and not run_mesh_check():
+        sys.exit(1)
+    if args.meshobs and not run_meshobs_check():
         sys.exit(1)
     if args.mem and not run_mem_check(grid):
         sys.exit(1)

@@ -1,0 +1,424 @@
+"""Mesh observatory (obs.meshobs) on the emulated 8-device mesh.
+
+The measured-byte contract is exact here: on an emulated mesh the
+compiled body IS the plan, so accumulated descriptor bytes must match
+the registered descriptors bit-exactly, and for names whose planner
+annotates descriptor-equal cost-model cbytes (SUMMA, the SpMV fan
+stages) the predicted-vs-measured drift ratio must be exactly 1.0.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from combblas_tpu import obs
+from combblas_tpu.obs import meshobs
+from combblas_tpu.models import bfs as B
+from combblas_tpu.ops import generate
+from combblas_tpu.ops import semiring as S
+from combblas_tpu.parallel import distmat as dm
+from combblas_tpu.parallel import distvec as dvec
+from combblas_tpu.parallel import spgemm as spg
+from combblas_tpu.parallel import spmv as pspmv
+from combblas_tpu.parallel.grid import ProcGrid, ROW_AXIS, COL_AXIS
+
+
+@pytest.fixture
+def obs_on():
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.costmodel.reset()
+    obs.REGISTRY.reset()
+    meshobs.reset()
+    obs.set_enabled(True)
+    yield
+    obs.set_enabled(False)
+    obs.reset()
+    obs.ledger.LEDGER.reset()
+    obs.costmodel.reset()
+    obs.REGISTRY.reset()
+    meshobs.reset()
+
+
+@pytest.fixture
+def mesh22(devices):
+    return ProcGrid.make(2, 2, devices[:4])
+
+
+def _rmat(grid, scale=8, seed=3, dtype=None):
+    n = 1 << scale
+    r, c = generate.rmat_edges(jax.random.key(seed), scale, 8)
+    r, c = generate.symmetrize(r, c)
+    a = dm.from_global_coo(S.LOR, grid, r, c,
+                           jnp.ones_like(r, jnp.bool_), n, n)
+    return a.astype(dtype) if dtype is not None else a
+
+
+class TestRegistry:
+    def test_descriptor_validation(self):
+        meshobs.reset()
+        with pytest.raises(ValueError, match="missing"):
+            meshobs.register_collectives("x", [{"collective": "psum"}])
+
+    def test_registration_replaces(self):
+        meshobs.reset()
+        d = dict(collective="psum", axis="r", dtype="float32",
+                 shape=(8,), rung=0, bytes=32)
+        meshobs.register_collectives("x", [d])
+        meshobs.register_collectives("x", [d, dict(d, rung=1)])
+        assert len(meshobs.descriptors("x")) == 2
+        meshobs.reset()
+
+    def test_device_loads_labels(self):
+        meshobs.reset()
+        meshobs.register_device_loads("x", nnz=np.arange(4).reshape(2, 2))
+        assert meshobs.device_loads("x")["nnz"] == {
+            "r0c0": 0.0, "r0c1": 1.0, "r1c0": 2.0, "r1c1": 3.0}
+        meshobs.register_device_loads(
+            "y", flops=np.arange(8).reshape(2, 2, 2))
+        assert meshobs.device_loads("y")["flops"]["l1r0c1"] == 5.0
+        meshobs.reset()
+
+
+class TestSummaMeasured:
+    def test_summa_bytes_bit_exact_and_drift_one(self, obs_on, mesh22):
+        """Measured bytes per axis == the registered SUMMA descriptors
+        x dispatch count, bit-exactly; drift pins 1.0."""
+        af = _rmat(mesh22, dtype=jnp.float32)
+        c = spg.spgemm(S.PLUS_TIMES_F32, af, af)
+        c.vals.block_until_ready()
+
+        descs = meshobs.descriptors("spgemm.summa")
+        assert descs, "plan_bcast registered no SUMMA descriptors"
+        assert {d["collective"] for d in descs} == {"psum"}
+        assert {d["axis"] for d in descs} <= {ROW_AXIS, COL_AXIS}
+        nd = meshobs.dispatches("spgemm.summa")
+        assert nd >= 1
+        want = {}
+        for d in descs:
+            k = (d["collective"], d["axis"])
+            want[k] = want.get(k, 0) + d["bytes"] * nd
+        got = {k: v["bytes"]
+               for k, v in meshobs.measured("spgemm.summa").items()}
+        assert got == want
+
+        # the planner annotates exactly these bytes as cbytes: the
+        # measured/predicted join is 1.0 by construction
+        assert meshobs.drift("spgemm.summa") == pytest.approx(1.0)
+
+        # per-axis fold covers both mesh axes of the broadcast pair
+        axes = meshobs.bytes_by_axis("spgemm.summa")
+        assert set(axes) == {ROW_AXIS, COL_AXIS}
+        assert sum(axes.values()) == sum(want.values())
+
+    def test_summa_device_loads_attribution(self, obs_on, mesh22):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        c = spg.spgemm(S.PLUS_TIMES_F32, af, af)
+        c.vals.block_until_ready()
+        loads = meshobs.device_loads("spgemm.summa")
+        assert set(loads) == {"flops", "nnz"}
+        assert set(loads["nnz"]) == {"r0c0", "r0c1", "r1c0", "r1c1"}
+        assert sum(loads["nnz"].values()) == float(
+            np.sum(np.asarray(af.nnz)))
+        # >= 90% of the ledger wall of a SUMMA-phase run must carry
+        # per-device attribution (the ISSUE's e2e pin)
+        assert meshobs.attribution_fraction() >= 0.9
+
+
+class TestSpmvMeasured:
+    def _frontier(self, grid, a):
+        ident = np.iinfo(np.int32).min
+        xv = np.full(a.nrows, ident, np.int64)
+        act = np.zeros(a.nrows, bool)
+        xv[0], act[0] = 0, True
+        x = dvec.from_global(grid, ROW_AXIS,
+                             jnp.asarray(xv, jnp.int32),
+                             fill=ident, block=a.tile_m)
+        return dvec.sp_from_dense_mask(x, dvec.from_global(
+            grid, ROW_AXIS, jnp.asarray(act), fill=False,
+            block=a.tile_m).data)
+
+    def test_fan_stages_drift_one(self, obs_on, mesh22):
+        """The phased SpMSpV dispatches fanout/local/fanin separately;
+        the registered fan descriptors equal the cost-model family
+        constant (4 B/row), so drift is exactly 1.0."""
+        a = _rmat(mesh22).astype(jnp.int32)
+        out = pspmv.spmsv_timed(S.SELECT2ND_MAX_I32, a,
+                                self._frontier(mesh22, a))
+        out.data.block_until_ready()
+        for name, coll in (("spmv.fanout", "all_gather"),
+                           ("spmv.fanin", "psum")):
+            descs = meshobs.descriptors(name)
+            assert [d["collective"] for d in descs] == [coll]
+            assert descs[0]["bytes"] == 4 * a.nrows
+            # descriptor bytes and dtype must agree (itemsize-derived,
+            # not a 4-byte pin): i32 vector -> 4 B/row
+            assert descs[0]["bytes"] == \
+                np.dtype(descs[0]["dtype"]).itemsize * a.nrows
+            assert descs[0]["axis"] == COL_AXIS
+            assert meshobs.dispatches(name) >= 1
+            m = meshobs.measured(name)
+            assert sum(v["bytes"] for v in m.values()) == \
+                4 * a.nrows * meshobs.dispatches(name)
+            assert meshobs.drift(name) == pytest.approx(1.0)
+        # every spmv.* name carries per-device nnz attribution
+        loads = meshobs.device_loads("spmv.fanout")
+        assert sum(loads["nnz"].values()) == float(
+            np.sum(np.asarray(a.nnz)))
+
+
+class TestBitsMeshMeasured:
+    def test_batch_bits_descriptors(self, obs_on, mesh22):
+        """The bits-mesh batch registers one LEVEL's collectives with
+        lane-exact shapes; measurement accumulates at dispatch."""
+        a = _rmat(mesh22, scale=9, seed=5)
+        plan = B.plan_bfs(a, route=True)
+        assert B.bits_fallback_reason(a, plan) is None
+        roots = jnp.arange(8, dtype=jnp.int32)
+        mv, _, _ = B.bfs_batch_bits_mesh(a, roots, plan=plan)
+        mv.data.block_until_ready()
+        descs = meshobs.descriptors("bfs.batch_bits_mesh")
+        nwv = -(-a.tile_m // 32)
+        by_coll = {(d["collective"], d["rung"]): d for d in descs}
+        assert by_coll[("ppermute", 0)]["bytes"] == 4 * nwv * 8
+        assert by_coll[("all_gather", 1)]["bytes"] == \
+            (mesh22.pc - 1) * 4 * nwv * 8
+        assert by_coll[("pmax", 3)]["bytes"] == 4 * a.tile_m * 8
+        assert meshobs.dispatches("bfs.batch_bits_mesh") >= 1
+        m = meshobs.measured("bfs.batch_bits_mesh")
+        assert sum(v["bytes"] for v in m.values()) == \
+            sum(d["bytes"] for d in descs) * \
+            meshobs.dispatches("bfs.batch_bits_mesh")
+        # plan_bfs registered the W=1 single-root set too
+        single = meshobs.descriptors("bfs.bits_mesh")
+        assert single and single[0]["bytes"] == 4 * nwv
+
+    def test_loads_registered_at_plan(self, obs_on, mesh22):
+        a = _rmat(mesh22, scale=9, seed=5)
+        B.plan_bfs(a)
+        loads = meshobs.device_loads("bfs.bits_mesh")
+        assert sum(loads["nnz"].values()) == float(
+            np.sum(np.asarray(a.nnz)))
+
+
+class TestFastSVMeasured:
+    def test_sharded_drift_joins(self, obs_on, mesh22):
+        """A sharded FastSV dispatch on the square mesh must join to a
+        non-None drift: the driver registers one body-iteration's
+        descriptors AND annotates descriptor-equal cbytes, so a single
+        dispatch measures exactly one prediction (ratio 1.0). The
+        value is not banded (the while_loop runs a data-dependent
+        iteration count) but the JOIN must exist — a None here means
+        the registered call site never met its prediction."""
+        from combblas_tpu.models import cc as CC
+        a = _rmat(mesh22, scale=8, seed=3)
+        labels = CC.fastsv(a)
+        labels.data.block_until_ready()
+        assert meshobs.dispatches("cc.fastsv_sharded") == 1
+        m = meshobs.measured("cc.fastsv_sharded")
+        assert sum(v["bytes"] for v in m.values()) == sum(
+            d["bytes"] for d in meshobs.descriptors("cc.fastsv_sharded"))
+        assert meshobs.drift("cc.fastsv_sharded") == pytest.approx(1.0)
+        # the cbytes prediction must SURVIVE the other plan-time
+        # annotations a real bench run piles on afterwards
+        # (annotate_matrix families, serve plan builds): re-annotating
+        # the same matrix must not null or clobber the cc join
+        obs.costmodel.annotate_matrix(a)
+        pspmv.annotate_costs(a)
+        assert meshobs.drift("cc.fastsv_sharded") == pytest.approx(1.0)
+        c = obs.costmodel.cost_for("cc.fastsv_sharded")
+        assert c is not None and c["cbytes"] > 0
+        # and a second driver call re-registers + re-annotates in
+        # lockstep: the per-call join stays 1.0, not 2.0
+        CC.fastsv(a).data.block_until_ready()
+        assert meshobs.drift("cc.fastsv_sharded") == pytest.approx(1.0)
+
+
+class TestSkew:
+    def test_skew_straggler_on_imbalanced_matrix(self, obs_on, mesh22):
+        """A deliberately imbalanced matrix (all edges in tile r0c0)
+        must show up as skew ~= p with the straggler named."""
+        n = 256
+        rr = jnp.arange(64, dtype=jnp.int32)
+        cc = (rr + 1) % 64
+        a = dm.from_global_coo(S.LOR, mesh22, rr, cc,
+                               jnp.ones_like(rr, jnp.bool_), n, n)
+        spg.plan_spgemm(a.astype(jnp.float32), a.astype(jnp.float32))
+        skew = meshobs.skew_summary()["spgemm.summa"]
+        assert skew["nnz"]["straggler"] == "r0c0"
+        assert skew["nnz"]["devices"] == 4
+        # 4 devices, all work on one: max/mean == 4
+        assert skew["nnz"]["max_over_mean"] == pytest.approx(4.0)
+
+    def test_device_wall_samples(self, obs_on):
+        meshobs.record_device_wall("r0c0", 0.3)
+        meshobs.record_device_wall("r0c1", 0.1)
+        meshobs.record_device_wall("r0c0", 0.1)
+        walls = meshobs.device_walls()
+        assert walls["r0c0"] == {"wall_s": 0.4, "samples": 2}
+        skew = meshobs.skew_summary()["device_wall"]["wall"]
+        assert skew["straggler"] == "r0c0"
+        assert skew["max_over_mean"] == pytest.approx(0.4 / 0.25)
+
+
+class TestSurfacing:
+    def test_dispatch_summary_mesh_block(self, obs_on, mesh22):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        spg.spgemm(S.PLUS_TIMES_F32, af, af).vals.block_until_ready()
+        ds = obs.dispatch_summary()
+        mesh = ds["mesh"]
+        assert "spgemm.summa" in mesh["registered_names"]
+        assert mesh["drift"]["spgemm.summa"] == pytest.approx(1.0)
+        assert mesh["attribution_frac"] >= 0.9
+        assert set(mesh["bytes_by_axis"]) >= {ROW_AXIS, COL_AXIS}
+
+    def test_format_table_drift_column(self, obs_on, mesh22):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        spg.spgemm(S.PLUS_TIMES_F32, af, af).vals.block_until_ready()
+        table = obs.ledger.format_table(k=10)
+        header = next(ln for ln in table.splitlines()
+                      if "executable" in ln)
+        assert "drift" in header
+        summa = [ln for ln in table.splitlines()
+                 if "spgemm.summa" in ln]
+        assert summa and "1.000" in summa[0]
+
+    def test_varz_and_metrics(self, obs_on, mesh22):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        spg.spgemm(S.PLUS_TIMES_F32, af, af).vals.block_until_ready()
+        srv = obs.serve_metrics(port=0)
+        try:
+            with urllib.request.urlopen(srv.url + "/varz",
+                                        timeout=10) as f:
+                varz = json.loads(f.read().decode())
+            assert varz["mesh"]["drift"]["spgemm.summa"] == \
+                pytest.approx(1.0)
+            assert "spgemm.summa" in varz["mesh"]["names"]
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=10) as f:
+                series = obs.parse_prometheus(f.read().decode())
+        finally:
+            srv.stop()
+        names = {nm for nm, _ in series}
+        assert "mesh_bytes" in names
+        assert "mesh_drift" in names
+        assert "mesh_attribution_frac" in names
+        drifts = {lbls: v for (nm, lbls), v in series.items()
+                  if nm == "mesh_drift"}
+        assert any(("name", "spgemm.summa") in lbls for lbls in drifts)
+
+    def test_mesh_summary_shape(self, obs_on, mesh22):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        spg.spgemm(S.PLUS_TIMES_F32, af, af).vals.block_until_ready()
+        ms = meshobs.mesh_summary()
+        row = ms["names"]["spgemm.summa"]
+        assert row["dispatches"] >= 1
+        assert row["descriptors"] == len(
+            meshobs.descriptors("spgemm.summa"))
+        assert all("/" in k for k in row["measured"])
+        assert json.loads(json.dumps(ms))  # artifact-serializable
+
+
+class TestPrometheusEscaping:
+    def test_hostile_label_round_trip(self, obs_on):
+        """Label values with quotes, newlines, and trailing
+        backslashes must survive render -> parse exactly (the ordered
+        sequential-replace parser corrupted backslash-n sequences)."""
+        hostile = 'a\\nb"c\\'           # literal backslash, n, quote…
+        newline = "x\ny"
+        g = obs.gauge("meshobs.esc_test", "hostile labels")
+        g.set(1.0, tag=hostile)
+        g.set(2.0, tag=newline)
+        text = obs.prometheus_text()
+        series = obs.parse_prometheus(text)
+        vals = {dict(lbls)["tag"]: v for (nm, lbls), v in series.items()
+                if nm == "meshobs_esc_test"}
+        assert vals[hostile] == 1.0
+        assert vals[newline] == 2.0
+
+
+class TestChromeTraceDevices:
+    def test_device_tracks_and_flows(self, obs_on, mesh22, tmp_path):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        spg.spgemm(S.PLUS_TIMES_F32, af, af).vals.block_until_ready()
+        out = tmp_path / "trace.json"
+        obs.chrome_trace(str(out))
+        events = json.loads(out.read_text())["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"
+                and e.get("pid") == 2]
+        assert any(e["name"] == "process_name" for e in meta)
+        thread_names = {e["args"]["name"] for e in meta
+                        if e["name"] == "thread_name"}
+        assert {"r0c0", "r0c1", "r1c0", "r1c1"} <= thread_names
+        devx = [e for e in events if e["ph"] == "X"
+                and e.get("pid") == 2]
+        assert devx, "no per-device dispatch spans"
+        flows = [e for e in events if e["ph"] in ("s", "f", "b", "e")
+                 and e.get("cat") == "collective"]
+        assert flows, "no collective flow events"
+
+    def test_foreign_device_ids_tolerated(self, obs_on, mesh22,
+                                          tmp_path):
+        """Descriptors with src labels outside the registered device
+        set (and registrations with no loads at all) must not break
+        the exporter."""
+        meshobs.register_collectives("weird.name", [
+            dict(collective="psum", axis="r", dtype="float32",
+                 shape=(4,), rung=0, bytes=16, src="zz9"),
+            dict(collective="psum", axis="c", dtype="float32",
+                 shape=(4,), rung=1, bytes=16),
+        ])
+        f = obs.instrument(lambda x: x + 1, "weird.name")
+        f(jnp.zeros((4,), jnp.float32)).block_until_ready()
+        # also register a real device's load grid: the missing-id
+        # sentinel track must stay clear of device tid 0
+        meshobs.register_device_loads("weird.name",
+                                      nnz=np.ones((2, 2)))
+        out = tmp_path / "trace.json"
+        obs.chrome_trace(str(out))
+        events = json.loads(out.read_text())["traceEvents"]
+        flows = [e for e in events if e.get("cat") == "collective"]
+        assert flows
+        real_tids = {e["tid"] for e in events if e["ph"] == "M"
+                     and e.get("pid") == 2
+                     and e["name"] == "thread_name"
+                     and e["args"]["name"].startswith("r")}
+        # the rung-1 descriptor has NO src/dst: its flow events must
+        # land on the dedicated "<no device>" track, never a real one
+        noneflows = [e for e in flows if e["args"].get("src") is None]
+        assert noneflows
+        assert not any(e["tid"] in real_tids for e in noneflows)
+        assert any(e["args"]["name"] == "<no device>"
+                   for e in events if e["ph"] == "M"
+                   and e.get("pid") == 2 and e["name"] == "thread_name")
+
+    def test_include_mesh_false(self, obs_on, mesh22, tmp_path):
+        af = _rmat(mesh22, dtype=jnp.float32)
+        spg.spgemm(S.PLUS_TIMES_F32, af, af).vals.block_until_ready()
+        out = tmp_path / "trace.json"
+        obs.chrome_trace(str(out), include_mesh=False)
+        events = json.loads(out.read_text())["traceEvents"]
+        assert not [e for e in events if e.get("pid") == 2]
+
+
+class TestPass9:
+    def test_committed_mesh_budget_green(self):
+        """Pass 9 over the committed budgets + artifacts must be
+        clean (same contract as the other artifact passes)."""
+        from combblas_tpu.analysis import meshbudget
+        findings = meshbudget.run_mesh()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_fixture_arms(self):
+        from combblas_tpu.analysis import core, meshbudget
+        import pathlib
+        fx = pathlib.Path(__file__).parent / "fixtures" / "analysis"
+        fs = meshbudget.run_mesh(files=[fx / "bad_mesh_budget.json"],
+                                 root=fx)
+        rules = {f.rule for f in fs}
+        assert {core.MESH_SKEW, core.MESH_BYTES, core.MESH_DRIFT,
+                core.MESH_STALE} <= rules
